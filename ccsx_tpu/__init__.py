@@ -1,0 +1,30 @@
+"""ccsx_tpu — a TPU-native framework for PacBio circular consensus (CCS/HiFi).
+
+A brand-new implementation of the capabilities of the CPU reference tool
+``110allan/ccsx`` (see /root/reference, SURVEY.md), redesigned for TPUs:
+
+* ingest: BAM / (gzipped) FASTA/FASTQ subread streams grouped by ZMW hole
+  (reference: seqio.h:152-201, bamlite.c, kseq.h);
+* prepare: per-hole pass orientation + clipping against a template pass
+  (reference: main.c:116-453);
+* consensus: the reference's banded-striped POA (external bsalign/BSPOA,
+  main.c:486-492,552-572) is *redesigned* as a template-anchored star MSA
+  with banded affine-gap DP batched over (ZMW x pass), majority-vote
+  columns and an iterative refinement pass — static shapes, vmap/shard_map
+  over a device mesh, Pallas kernels for the DP fill;
+* pipeline: 3-stage read/compute/write overlap (reference: kthread.c:172-256)
+  as host threads feeding the device asynchronously, order-preserving.
+
+Layout:
+  config        — all parity-critical constants (SURVEY.md §2.5)
+  io/           — parsers + ZMW streamer (python fallback + C++ native)
+  ops/          — encode tables, batched DP, traceback/projection, MSA ops
+  consensus/    — prepare (orientation), whole-read + windowed consensus
+  parallel/     — mesh construction, shard_map wrappers, multi-host
+  pipeline/     — chunked async pipeline, bucketizer, writer
+  utils/        — metrics, journal, profiling
+"""
+
+__version__ = "0.1.0"
+
+from ccsx_tpu.config import CcsConfig  # noqa: F401

@@ -1,0 +1,96 @@
+"""Configuration for ccsx_tpu.
+
+All parity-critical constants of the reference are collected here with their
+source citations (reference = /root/reference, catalogued in SURVEY.md §2.5).
+TPU-specific knobs (buckets, band widths, microbatch sizes) are grouped at the
+bottom; they control tiling only, never semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class AlignParams:
+    """Alignment scoring parameters.
+
+    Defaults mirror the BSPOA parameters the reference wires up at
+    main.c:841-850 (M=2 X=-6 O=-3 E=-2, bandwidth=128).  The reference's
+    second affine channel is disabled there (Q=P=0), so we model a single
+    affine gap.
+    """
+
+    match: int = 2
+    mismatch: int = -6
+    gap_open: int = -3     # charged on the first gap base *in addition* to gap_extend
+    gap_extend: int = -2
+    band: int = 128        # main.c:849 bandwidth=128 == TPU lane width
+
+
+@dataclasses.dataclass
+class CcsConfig:
+    # ---- CLI-equivalent options (reference main.c:751-800) ----
+    min_subread_len: int = 5000        # -m, main.c:753
+    max_subread_len: int = 500000      # -M, main.c:753
+    min_fulllen_count: int = 3         # -c (>=3 enforced, main.c:786-789);
+    #   a hole is kept iff its subread count >= min_fulllen_count + 2 (main.c:659)
+    split_subread: bool = True         # default shred mode; -P selects whole-read (main.c:754,766)
+    is_bam: bool = True                # -A selects FASTA/Q (main.c:770)
+    exclude_holes: Optional[frozenset] = None   # -X comma list (main.c:772-783)
+    threads: int = 1                   # -j host-side worker threads (main.c:754)
+    verbose: int = 0                   # -v repeatable (main.c:791-793)
+
+    # ---- prepare / orientation (main.c:116-453) ----
+    group_tolerance_pct: int = 10      # length-cluster tolerance (main.c:350)
+    strand_identity_pct: int = 75      # strand_match accept identity (main.c:392)
+    border_identity_pct: int = 70      # template border RC check (main.c:326,332)
+    border_len: int = 1000             # border length for template check (main.c:324)
+    border_min_template: int = 2000    # candidate median len must exceed (main.c:320)
+    # candidate group must have >= 2 members and size*5 >= 4*size(best) (main.c:312-313)
+
+    # ---- windowed consensus (ccs_for2, main.c:541-546) ----
+    bp_window: int = 10                # breakpoint window: consecutive MSA cols
+    bp_minwin: int = 5                 # min consensus-base cols in the window
+    bp_rowrate: int = 80               # per-row agreement %, main.c:541
+    bp_colrate: int = 80               # per-col agreement % (60 if <10 passes, main.c:546)
+    bp_colrate_lowpass: int = 60
+    window_init: int = 2048            # reference initlen=2000; we round to a lane
+    window_add: int = 2048             # reference addlen=2000
+    window_minlen: int = 1024          # reference minlen=1000: min tail beyond window
+    max_window: int = 8192             # growth cap before force-flush (TPU memory bound)
+
+    # ---- consensus redesign knobs (no reference equivalent) ----
+    refine_iters: int = 1              # realign-to-draft refinement rounds
+    max_ins_per_col: int = 4           # inserted bases stored per (pass, template col)
+
+    # ---- alignment scoring ----
+    align: AlignParams = dataclasses.field(default_factory=AlignParams)
+
+    # ---- pipeline (worker_pipeline, main.c:649-720) ----
+    chunk_size: int = 1024             # main.c:833; grows x4 to cap (main.c:686-691)
+    chunk_growth: int = 4
+    chunk_cap: int = 16384
+
+    # ---- TPU tiling ----
+    pass_buckets: tuple = (4, 8, 16, 32)   # passes padded to the next bucket
+    max_passes: int = 32               # extra passes beyond this are dropped (deepest
+    #   passes add negligible consensus signal; reference keeps all — documented delta)
+    zmw_microbatch: int = 64           # ZMWs per device dispatch
+    len_bucket_quant: int = 512        # whole-read mode: lengths padded to multiple
+
+    # ---- device/mesh ----
+    device: str = "auto"               # {auto, tpu, cpu}
+    mesh_shape: Optional[tuple] = None  # e.g. (8,) data; None = all local devices
+
+    def __post_init__(self):
+        if self.min_fulllen_count < 3:
+            raise ValueError(
+                f"min fulllen count={self.min_fulllen_count} (>=3)!"  # main.c:787
+            )
+
+    @property
+    def min_pass_count(self) -> int:
+        """A hole is kept iff subread count >= this (main.c:659)."""
+        return self.min_fulllen_count + 2

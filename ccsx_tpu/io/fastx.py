@@ -1,0 +1,83 @@
+"""FASTA/FASTQ parser (plain or gzip), kseq-equivalent semantics.
+
+Replicates the behavior of the reference's kseq.h state machine
+(kseq.h:177-218): records start at '>' or '@'; sequence may span multiple
+lines; FASTQ quality runs until it reaches sequence length; name is the first
+whitespace-delimited token, the rest is the comment.  This is the Python
+fallback path; the hot path is the native C++ reader (ccsx_tpu/native).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import gzip
+import io
+from typing import Iterator, Optional, Union
+
+
+@dataclasses.dataclass
+class FastxRecord:
+    name: str
+    comment: str
+    seq: bytes
+    qual: Optional[bytes]  # None for FASTA
+
+
+def _open(path_or_file) -> io.BufferedReader:
+    if hasattr(path_or_file, "read"):
+        f = path_or_file
+        if not hasattr(f, "peek"):  # e.g. raw BytesIO: make it peekable
+            f = io.BufferedReader(f)
+        # transparently un-gzip file objects too
+        if f.peek(2)[:2] == b"\x1f\x8b":
+            return io.BufferedReader(gzip.GzipFile(fileobj=f))
+        return f
+    path = str(path_or_file)
+    f = open(path, "rb")
+    if f.peek(2)[:2] == b"\x1f\x8b":
+        return io.BufferedReader(gzip.GzipFile(fileobj=f))
+    return f
+
+
+def read_fastx(path_or_file) -> Iterator[FastxRecord]:
+    """Stream records from a FASTA/FASTQ file (gzip transparent)."""
+    f = _open(path_or_file)
+    line = f.readline()
+    # skip leading junk until a record marker (kseq skips to '>'/'@')
+    while line and line[:1] not in (b">", b"@"):
+        line = f.readline()
+    while line:
+        marker = line[:1]
+        header = line[1:].rstrip(b"\r\n")
+        parts = header.split(None, 1)
+        name = parts[0].decode() if parts else ""
+        comment = parts[1].decode() if len(parts) > 1 else ""
+        seq_parts = []
+        line = f.readline()
+        while line and line[:1] not in (b">", b"@", b"+"):
+            seq_parts.append(line.strip())
+            line = f.readline()
+        seq = b"".join(seq_parts)
+        qual = None
+        # kseq parity: a '+' line starts a quality section after ANY record,
+        # even a '>' one (kseq.h:196 checks only for '+'); quality is
+        # reported only for FASTQ records.
+        if line[:1] == b"+":
+            # quality: read until length matches seq (kseq.h:203-211)
+            qual_parts = []
+            got = 0
+            line = f.readline()
+            while line and got < len(seq):
+                chunk = line.strip()
+                qual_parts.append(chunk)
+                got += len(chunk)
+                line = f.readline()
+            qual = b"".join(qual_parts)
+            if len(qual) != len(seq):
+                raise ValueError(
+                    f"FASTQ record {name}: quality length {len(qual)} != "
+                    f"sequence length {len(seq)}"
+                )
+            if marker != b"@":
+                qual = None
+        yield FastxRecord(name=name, comment=comment, seq=seq, qual=qual)

@@ -1,0 +1,102 @@
+"""ZMW group-by-hole streaming over any subread record source.
+
+Equivalent of the reference's kseq_zmw_read (seqio.h:152-201): subread names
+follow the PacBio convention ``movie/hole/region``; consecutive records with
+the same (movie, hole) belong to one ZMW and are accumulated into a single
+concatenated buffer plus a lengths vector.  A name that does not split into
+exactly 3 '/'-fields is invalid (seqio.h:168-172; the reference kills the
+whole stream there — we raise by default, or quarantine when configured).
+
+Filters (applied by the pipeline's read step in the reference,
+main.c:659-672) are provided here as `zmw_filter`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Iterator, List, Optional
+
+import numpy as np
+
+from ccsx_tpu.config import CcsConfig
+from ccsx_tpu.io.fastx import FastxRecord
+
+
+class InvalidZmwName(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class Zmw:
+    """One hole's worth of subreads (reference zmw_t, main.c:42-48)."""
+
+    movie: str
+    hole: str
+    seqs: bytes                # concatenated subread bases (ASCII)
+    lens: np.ndarray           # int32 per-subread lengths
+    offs: np.ndarray           # int32 prefix offsets into seqs
+    ccs: Optional[bytes] = None   # filled by the consensus stage
+
+    @property
+    def n_passes(self) -> int:
+        return len(self.lens)
+
+    @property
+    def total_len(self) -> int:
+        return len(self.seqs)
+
+    def subread(self, i: int) -> bytes:
+        o = int(self.offs[i])
+        return self.seqs[o:o + int(self.lens[i])]
+
+
+def split_name(name: str) -> tuple:
+    fields = name.split("/")
+    if len(fields) != 3:
+        raise InvalidZmwName(f"invalid zmw name :{name}")
+    return fields[0], fields[1], fields[2]
+
+
+def group_zmws(records: Iterable[FastxRecord]) -> Iterator[Zmw]:
+    """Group consecutive records by (movie, hole) into Zmw objects."""
+    cur_key = None
+    cur_seqs: List[bytes] = []
+    for rec in records:
+        movie, hole, _region = split_name(rec.name)
+        key = (movie, hole)
+        if cur_key is None:
+            cur_key, cur_seqs = key, [rec.seq]
+        elif key == cur_key:
+            cur_seqs.append(rec.seq)
+        else:
+            yield _build(cur_key, cur_seqs)
+            cur_key, cur_seqs = key, [rec.seq]
+    if cur_key is not None:
+        yield _build(cur_key, cur_seqs)
+
+
+def _build(key: tuple, seqs: List[bytes]) -> Zmw:
+    lens = np.array([len(s) for s in seqs], dtype=np.int32)
+    offs = np.zeros(len(seqs), dtype=np.int32)
+    if len(seqs) > 1:
+        np.cumsum(lens[:-1], out=offs[1:])
+    return Zmw(movie=key[0], hole=key[1], seqs=b"".join(seqs),
+               lens=lens, offs=offs)
+
+
+def zmw_filter(zmw: Zmw, cfg: CcsConfig) -> bool:
+    """Keep/drop rule of the pipeline read step (main.c:659-672)."""
+    if zmw.n_passes < cfg.min_pass_count:
+        return False
+    total = zmw.total_len
+    if total > cfg.max_subread_len or total < cfg.min_subread_len:
+        return False
+    if cfg.exclude_holes and zmw.hole in cfg.exclude_holes:
+        return False
+    return True
+
+
+def stream_zmws(records: Iterable[FastxRecord], cfg: CcsConfig) -> Iterator[Zmw]:
+    for z in group_zmws(records):
+        if zmw_filter(z, cfg):
+            yield z

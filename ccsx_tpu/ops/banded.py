@@ -1,0 +1,415 @@
+"""Batched banded affine-gap alignment in JAX (TPU-native DP).
+
+This replaces the role of bsalign's banded-striped SIMD kernels
+(kmer_striped_seqedit_pairwise at main.c:264; BSPOA's banded DP fill used via
+end_bspoa at main.c:492) with an idiomatic TPU design:
+
+* the band is a fixed 128-lane vector (the reference's bandwidth=128,
+  main.c:849, conveniently equals the TPU lane width);
+* the fill is a ``lax.scan`` over query rows; all per-row work is elementwise
+  VPU math over the band;
+* the horizontal (within-row) affine gap is resolved with an associative
+  max-plus prefix scan instead of a serial loop:
+      F[j] = max_{j'<j} (Hd[j'] + O + E*(j-j'))
+           = E*j + cummax_{j'<j}(Hd[j'] + O - E*j')
+  which is exact for affine gaps because re-opening a horizontal gap from a
+  horizontal-gap cell is dominated when O <= 0 (Gotoh);
+* the band follows a deterministic nominal line from (i0, j0) to (i1, j1)
+  (defaults: the global corners), with shifts bounded by ``maxshift`` so
+  previous-row values align via a dynamic slice.  Off-diagonal alignments
+  (clipped passes, border checks) pass a seeded diagonal hint from the
+  host-side k-mer voting stage (ops/seed.py), mirroring the reference's
+  k-mer-seeded pairwise (kmer_striped_seqedit_pairwise, main.c:264).
+  Score-argmax band adaptation was tried and rejected: under low signal the
+  argmax follows noise, and with the monotone-offset constraint the band
+  ratchets ahead of the true path;
+* path statistics (matches, columns, query start) are carried *through* the
+  recurrence as extra channels selected by the same argmax decisions, so
+  strand_match-style queries (score/identity/clip span, main.c:280) need no
+  traceback at all;
+* ``mode='global'`` can emit a packed move byte per cell for the consensus
+  traceback (ops/traceback.py).
+
+Everything is static-shape: sequences are padded to (Qmax,), (Tmax,) with the
+PAD code and true lengths passed as scalars; rows beyond qlen freeze the
+carry, so the final carry holds row qlen exactly.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ccsx_tpu.config import AlignParams
+
+NEG = -(2 ** 28)
+PAD = 5
+
+# move byte layout (global mode): bits 0-1 = H choice (0 diag, 1 E/up, 2 F/left)
+# bit 2 = E reached by gap-extend (else gap-open); bit 3 = same for F.
+MOVE_DIAG, MOVE_UP, MOVE_LEFT = 0, 1, 2
+EBIT_EXT = 4
+FBIT_EXT = 8
+
+
+class BandedResult(NamedTuple):
+    score: jnp.ndarray
+    qb: jnp.ndarray
+    qe: jnp.ndarray
+    tb: jnp.ndarray
+    te: jnp.ndarray
+    aln: jnp.ndarray
+    mat: jnp.ndarray
+
+
+def _combine_rightmax(a, b):
+    """Associative combiner: pick the tuple with the larger score (ties: right)."""
+    take_b = b[0] >= a[0]
+    return tuple(jnp.where(take_b, xb, xa) for xa, xb in zip(a, b))
+
+
+def _shift_right(x, fill):
+    return jnp.concatenate([jnp.full((1,), fill, x.dtype), x[:-1]])
+
+
+def _pad_prev(row, maxshift):
+    """[NEG, row, NEG*maxshift] so diag/up lanes are a dynamic slice at d, d+1."""
+    return jnp.concatenate(
+        [jnp.full((1,), NEG, row.dtype), row,
+         jnp.full((maxshift,), NEG, row.dtype)]
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mode", "params", "band", "maxshift", "with_moves",
+                     "with_debug"),
+)
+def banded_align(
+    q: jnp.ndarray,
+    qlen: jnp.ndarray,
+    t: jnp.ndarray,
+    tlen: jnp.ndarray,
+    mode: str = "global",
+    params: AlignParams = AlignParams(),
+    band: int | None = None,
+    maxshift: int = 4,
+    with_moves: bool = False,
+    with_debug: bool = False,
+    line: tuple | None = None,
+):
+    """Align one (padded) query against one (padded) template.
+
+    Args:
+      q: (Qmax,) uint8 codes, PAD beyond qlen.
+      qlen: scalar int32 true length.
+      t: (Tmax,) uint8 codes, PAD beyond tlen.
+      tlen: scalar int32 true length.
+      mode: 'global' | 'qfree' (query ends free, template end-to-end)
+            | 'local' (both ends free, scores clamped at 0).
+      with_moves: in global mode, also return (moves, offs) for traceback.
+      line: optional (4,) int32 array (i0, j0, i1, j1) — the nominal
+            alignment line the band is centered on; defaults to the global
+            corners (0, 0, qlen, tlen).  Pass a seeded diagonal here for
+            off-diagonal local alignments (e.g. (qb_hint, tb_hint,
+            qb_hint+L, tb_hint+L)).
+
+    Returns:
+      BandedResult, or (BandedResult, moves (Qmax, band) uint8,
+      offs (Qmax,) int32) when with_moves.
+
+    Batch by ``jax.vmap`` over leading axes of (q, qlen, t, tlen).
+    """
+    if with_moves and mode != "global":
+        raise ValueError("moves only supported in global mode")
+    M, X = params.match, params.mismatch
+    O, Eext = params.gap_open, params.gap_extend
+    B = band if band is not None else params.band
+    Qmax = q.shape[0]
+    qlen = qlen.astype(jnp.int32)
+    tlen = tlen.astype(jnp.int32)
+
+    q = q.astype(jnp.int32)
+    # tpad[off + k] == t[off + k - 1] (the base entering column j = off + k)
+    tpad = jnp.concatenate(
+        [jnp.full((1,), PAD, jnp.int32), t.astype(jnp.int32),
+         jnp.full((B + maxshift,), PAD, jnp.int32)]
+    )
+    karr = jnp.arange(B, dtype=jnp.int32)
+    tcap = jnp.maximum(tlen - B + 1, 0)  # max feasible band offset
+
+    if line is None:
+        # global: corner-to-corner.  qfree: slope-1 from the origin — the
+        # template is assumed prefix-anchored in the query; a query with a
+        # junk *prefix* needs a seeded `line` hint or the band misses the
+        # path entirely.  local: corner-to-corner (similar-length pairs);
+        # off-diagonal local alignments also need a seeded hint.
+        if mode == "qfree":
+            li0, lj0, li1, lj1 = (
+                jnp.int32(0), jnp.int32(0), tlen, tlen,
+            )
+        else:
+            li0, lj0, li1, lj1 = (
+                jnp.int32(0), jnp.int32(0), qlen, tlen,
+            )
+    else:
+        line = jnp.asarray(line, dtype=jnp.int32)
+        li0, lj0, li1, lj1 = line[0], line[1], line[2], line[3]
+
+    # ---- row 0 ----
+    j0 = karr  # off = 0
+    if mode == "local":
+        H0 = jnp.where(j0 <= tlen, 0, NEG)
+    else:
+        H0 = jnp.where(j0 <= tlen, jnp.where(j0 == 0, 0, O + Eext * j0), NEG)
+    E0 = jnp.full((B,), NEG, jnp.int32)
+    mat0 = jnp.zeros((B,), jnp.int32)
+    if mode == "local":
+        aln0 = jnp.zeros((B,), jnp.int32)
+    else:
+        aln0 = j0  # leading template-gap columns count toward aln
+    qb0 = jnp.zeros((B,), jnp.int32)
+    tb0 = j0 if mode == "local" else jnp.zeros((B,), jnp.int32)
+    Emat0, Ealn0, Eqb0, Etb0 = mat0, aln0, qb0, tb0
+
+    # best-tracker: (score, qe, mat, aln, qb, tb, te)
+    best0 = (
+        jnp.int32(NEG), jnp.int32(0), jnp.int32(0), jnp.int32(0),
+        jnp.int32(0), jnp.int32(0), jnp.int32(0),
+    )
+
+    carry0 = dict(
+        H=H0, E=E0, off=jnp.int32(0),
+        mat=mat0, aln=aln0, qb=qb0, tb=tb0,
+        Emat=Emat0, Ealn=Ealn0, Eqb=Eqb0, Etb=Etb0,
+        best=best0,
+    )
+
+    def body(carry, xs):
+        i, qi = xs  # i in 1..Qmax; qi = q[i-1]
+        H_prev, E_prev, off_prev = carry["H"], carry["E"], carry["off"]
+
+        # --- band offset for this row (nominal line, monotone, coverage-safe) ---
+        nom_j = lj0 + ((i - li0) * (lj1 - lj0)) // jnp.maximum(li1 - li0, 1)
+        desired = nom_j - B // 2
+        if mode == "local":
+            lo = jnp.int32(0)
+        else:
+            # guarantee the band can reach column tlen by row qlen
+            lo = jnp.maximum(0, tcap - (qlen - i) * maxshift)
+        off = jnp.clip(
+            jnp.maximum(desired, lo), off_prev,
+            jnp.minimum(off_prev + maxshift, tcap),
+        )
+        off = jnp.maximum(off, off_prev)  # monotone even if tcap < off_prev
+        d = off - off_prev
+
+        j = off + karr
+        tb_band = jax.lax.dynamic_slice(tpad, (off,), (B,))
+        sub = jnp.where((qi == tb_band) & (qi < 4) & (tb_band < 4), M, X)
+        ismatch = (qi == tb_band) & (qi < 4) & (tb_band < 4)
+
+        def shifted(row, ofs):
+            return jax.lax.dynamic_slice(_pad_prev(row, maxshift), (d + ofs,), (B,))
+
+        Hd_diag = shifted(H_prev, 0)
+        H_up = shifted(H_prev, 1)
+        E_up = shifted(E_prev, 1)
+        mat_diag = shifted(carry["mat"], 0)
+        aln_diag = shifted(carry["aln"], 0)
+        qb_diag = shifted(carry["qb"], 0)
+        tb_diag = shifted(carry["tb"], 0)
+        mat_up = shifted(carry["mat"], 1)
+        aln_up = shifted(carry["aln"], 1)
+        qb_up = shifted(carry["qb"], 1)
+        tb_up = shifted(carry["tb"], 1)
+        Emat_up = shifted(carry["Emat"], 1)
+        Ealn_up = shifted(carry["Ealn"], 1)
+        Eqb_up = shifted(carry["Eqb"], 1)
+        Etb_up = shifted(carry["Etb"], 1)
+
+        # --- E (vertical: consume query base, gap in template) ---
+        e_ext = E_up + Eext
+        e_open = H_up + O + Eext
+        e_is_open = e_open >= e_ext
+        Enew = jnp.maximum(e_ext, e_open)
+        Emat = jnp.where(e_is_open, mat_up, Emat_up)
+        Ealn = jnp.where(e_is_open, aln_up, Ealn_up) + 1
+        Eqb = jnp.where(e_is_open, qb_up, Eqb_up)
+        Etb = jnp.where(e_is_open, tb_up, Etb_up)
+
+        # --- Hd = best of diag / E ---
+        diag_term = Hd_diag + sub
+        d_wins = diag_term >= Enew
+        Hd = jnp.maximum(diag_term, Enew)
+        Hmat = jnp.where(d_wins, mat_diag + ismatch, Emat)
+        Haln = jnp.where(d_wins, aln_diag, Ealn - 1) + 1
+        Hqb = jnp.where(d_wins, qb_diag, Eqb)
+        Htb = jnp.where(d_wins, tb_diag, Etb)
+
+        # --- boundary lane j == 0 (only if off == 0) ---
+        at0 = j == 0
+        if mode == "global":
+            b_H = O + Eext * i
+            b_mat, b_aln, b_qb, b_tb = 0, i, 0, 0
+            Hd = jnp.where(at0, b_H, Hd)
+            Enew = jnp.where(at0, b_H, Enew)
+            Hmat = jnp.where(at0, b_mat, Hmat)
+            Haln = jnp.where(at0, b_aln, Haln)
+            Hqb = jnp.where(at0, b_qb, Hqb)
+            Htb = jnp.where(at0, b_tb, Htb)
+            Emat = jnp.where(at0, b_mat, Emat)
+            Ealn = jnp.where(at0, b_aln, Ealn)
+            Eqb = jnp.where(at0, b_qb, Eqb)
+            Etb = jnp.where(at0, b_tb, Etb)
+        elif mode == "qfree":
+            Hd = jnp.where(at0, 0, Hd)
+            Enew = jnp.where(at0, NEG, Enew)
+            Hmat = jnp.where(at0, 0, Hmat)
+            Haln = jnp.where(at0, 0, Haln)
+            Hqb = jnp.where(at0, i, Hqb)
+            Htb = jnp.where(at0, 0, Htb)
+
+        # --- invalid lanes (beyond template) ---
+        invalid = j > tlen
+        Hd = jnp.where(invalid, NEG, Hd)
+        Enew = jnp.where(invalid, NEG, Enew)
+
+        # --- F (horizontal) via associative max-plus prefix scan ---
+        v = Hd + O - Eext * karr
+        elems = (v, Hmat, Haln - karr, Hqb, Htb)
+        cum = jax.lax.associative_scan(_combine_rightmax, elems)
+        sh = tuple(
+            _shift_right(x, NEG if idx == 0 else 0)
+            for idx, x in enumerate(cum)
+        )
+        F = sh[0] + Eext * karr
+        Fmat = sh[1]
+        Faln = sh[2] + karr
+        Fqb = sh[3]
+        Ftb = sh[4]
+
+        # --- H = max(Hd, F) ---
+        hd_wins = Hd >= F
+        Hnew = jnp.maximum(Hd, F)
+        mat_new = jnp.where(hd_wins, Hmat, Fmat)
+        aln_new = jnp.where(hd_wins, Haln, Faln)
+        qb_new = jnp.where(hd_wins, Hqb, Fqb)
+        tb_new = jnp.where(hd_wins, Htb, Ftb)
+
+        if mode == "local":
+            clamp = Hnew < 0
+            Hnew = jnp.where(clamp, 0, Hnew)
+            mat_new = jnp.where(clamp, 0, mat_new)
+            aln_new = jnp.where(clamp, 0, aln_new)
+            qb_new = jnp.where(clamp, i, qb_new)
+            tb_new = jnp.where(clamp, j, tb_new)
+            Hnew = jnp.where(invalid, NEG, Hnew)
+
+        # --- moves byte (global traceback) ---
+        if with_moves:
+            choice = jnp.where(
+                hd_wins & d_wins, MOVE_DIAG,
+                jnp.where(hd_wins, MOVE_UP, MOVE_LEFT),
+            ).astype(jnp.uint8)
+            ebit = jnp.where(e_is_open, 0, EBIT_EXT).astype(jnp.uint8)
+            H_left = _shift_right(Hnew, NEG)
+            f_is_open = F == (H_left + O + Eext)
+            fbit = jnp.where(f_is_open, 0, FBIT_EXT).astype(jnp.uint8)
+            moves_row = choice | ebit | fbit
+        else:
+            moves_row = jnp.zeros((B,), jnp.uint8)
+
+        # --- trackers ---
+        best = carry["best"]
+        live = i <= qlen
+        if mode == "qfree" or mode == "global":
+            laneT = tlen - off
+            ok = live & (laneT >= 0) & (laneT < B)
+            laneTc = jnp.clip(laneT, 0, B - 1)
+            val = jnp.where(ok, Hnew[laneTc], NEG)
+            cand = (
+                val, i, mat_new[laneTc], aln_new[laneTc],
+                qb_new[laneTc], tb_new[laneTc], tlen,
+            )
+            take = cand[0] > best[0]
+            best = tuple(jnp.where(take, c, b) for c, b in zip(cand, best))
+        else:  # local
+            masked = jnp.where(j <= tlen, Hnew, NEG)
+            lane = jnp.argmax(masked).astype(jnp.int32)
+            val = jnp.where(live, masked[lane], NEG)
+            cand = (
+                val, i, mat_new[lane], aln_new[lane],
+                qb_new[lane], tb_new[lane], off + lane,
+            )
+            take = cand[0] > best[0]
+            best = tuple(jnp.where(take, c, b) for c, b in zip(cand, best))
+
+        # --- freeze rows beyond qlen ---
+        def frz(new, old):
+            return jnp.where(live, new, old)
+
+        new_carry = dict(
+            H=frz(Hnew, H_prev), E=frz(Enew, E_prev), off=frz(off, off_prev),
+            mat=frz(mat_new, carry["mat"]), aln=frz(aln_new, carry["aln"]),
+            qb=frz(qb_new, carry["qb"]), tb=frz(tb_new, carry["tb"]),
+            Emat=frz(Emat, carry["Emat"]), Ealn=frz(Ealn, carry["Ealn"]),
+            Eqb=frz(Eqb, carry["Eqb"]), Etb=frz(Etb, carry["Etb"]),
+            best=best,
+        )
+        if with_moves:
+            ys = (moves_row, frz(off, off_prev))
+        elif with_debug:
+            dbg_max = jnp.max(jnp.where(j <= tlen, Hnew, NEG))
+            dbg_arg = jnp.argmax(jnp.where(j <= tlen, Hnew, NEG)).astype(jnp.int32)
+            ys = (frz(off, off_prev), dbg_max, dbg_arg)
+        else:
+            ys = None
+        return new_carry, ys
+
+    xs = (jnp.arange(1, Qmax + 1, dtype=jnp.int32), q)
+    carry, ys = jax.lax.scan(body, carry0, xs)
+
+    if mode == "global":
+        laneT = tlen - carry["off"]
+        reachable = (laneT >= 0) & (laneT < B)  # band covered column tlen
+        lane = jnp.clip(laneT, 0, B - 1)
+        res = BandedResult(
+            score=jnp.where(reachable, carry["H"][lane], NEG),
+            qb=jnp.int32(0), qe=qlen, tb=jnp.int32(0), te=tlen,
+            aln=jnp.where(reachable, carry["aln"][lane], 0),
+            mat=jnp.where(reachable, carry["mat"][lane], 0),
+        )
+    else:
+        s, qe, mat, aln, qb, tb, te = carry["best"]
+        res = BandedResult(score=s, qb=qb, qe=qe, tb=tb, te=te,
+                           aln=aln, mat=mat)
+    if with_moves:
+        moves, offs = ys
+        return res, moves, offs
+    if with_debug:
+        return res, ys
+    return res
+
+
+# Batched variant ------------------------------------------------------------
+
+
+def make_batched(mode: str, params: AlignParams, band: int | None = None,
+                 maxshift: int = 4, with_moves: bool = False,
+                 with_line: bool = False):
+    """A jitted, vmapped aligner with static config baked in.
+
+    With ``with_line``, the batched function takes a fifth argument:
+    (batch, 4) int32 nominal-line hints (see banded_align's ``line``).
+    """
+    f = functools.partial(
+        banded_align, mode=mode, params=params, band=band,
+        maxshift=maxshift, with_moves=with_moves,
+    )
+    if with_line:
+        return jax.jit(jax.vmap(lambda q, ql, t, tl, line: f(q, ql, t, tl, line=line)))
+    return jax.jit(jax.vmap(f))

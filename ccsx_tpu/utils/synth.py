@@ -1,0 +1,100 @@
+"""Synthetic ZMW/subread generator for tests and benchmarks.
+
+Models the PacBio data the reference consumes: a circular template read many
+times with alternating strand per pass (main.c:374-375 walks outward from the
+template alternating expected strand), each pass an independently noisy copy
+(mismatches + insertions + deletions).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+
+from ccsx_tpu.ops import encode as enc
+
+
+@dataclasses.dataclass
+class SynthZmw:
+    movie: str
+    hole: str
+    template: np.ndarray          # 2-bit codes
+    passes: List[np.ndarray]      # 2-bit codes, oriented as sequenced
+    strands: List[int]            # 0 fwd / 1 rev per pass
+
+    @property
+    def names(self) -> List[str]:
+        out = []
+        off = 0
+        for p in self.passes:
+            out.append(f"{self.movie}/{self.hole}/{off}_{off + len(p)}")
+            off += len(p)
+        return out
+
+    def fasta(self) -> str:
+        recs = []
+        for name, p in zip(self.names, self.passes):
+            recs.append(f">{name}\n{enc.decode(p)}\n")
+        return "".join(recs)
+
+
+def mutate(
+    rng: np.random.Generator,
+    seq: np.ndarray,
+    sub_rate: float,
+    ins_rate: float,
+    del_rate: float,
+) -> np.ndarray:
+    """Apply independent per-base errors to a 2-bit sequence."""
+    out = []
+    for b in seq:
+        r = rng.random()
+        if r < del_rate:
+            continue
+        if r < del_rate + sub_rate:
+            out.append((int(b) + 1 + rng.integers(3)) % 4)
+        else:
+            out.append(int(b))
+        while rng.random() < ins_rate:
+            out.append(int(rng.integers(4)))
+    return np.array(out, dtype=np.uint8)
+
+
+def make_zmw(
+    rng: np.random.Generator,
+    template_len: int = 1000,
+    n_passes: int = 5,
+    sub_rate: float = 0.02,
+    ins_rate: float = 0.04,
+    del_rate: float = 0.04,
+    movie: str = "m0",
+    hole: str = "1",
+    first_strand: int = 0,
+    template: Optional[np.ndarray] = None,
+) -> SynthZmw:
+    if template is None:
+        template = rng.integers(0, 4, size=template_len).astype(np.uint8)
+    passes, strands = [], []
+    for k in range(n_passes):
+        strand = (first_strand + k) % 2
+        p = mutate(rng, template, sub_rate, ins_rate, del_rate)
+        if strand:
+            p = enc.revcomp_codes(p)
+        passes.append(p)
+        strands.append(strand)
+    return SynthZmw(movie=movie, hole=hole, template=template,
+                    passes=passes, strands=strands)
+
+
+def make_fasta(zmws: List[SynthZmw]) -> str:
+    return "".join(z.fasta() for z in zmws)
+
+
+def identity(a: np.ndarray, b: np.ndarray) -> float:
+    """Global-alignment identity between two code sequences (oracle-based)."""
+    from ccsx_tpu.ops import oracle
+
+    rs = oracle.align(a, b, mode="global")
+    return rs.identity

@@ -1,0 +1,184 @@
+"""Differential tests: JAX banded DP vs the NumPy oracle.
+
+With band=128 and short sequences the band covers the full DP matrix, so
+scores must match the unbanded oracle exactly.  Path statistics (mat/aln)
+can differ between co-optimal paths; we check them on unambiguous cases and
+check the strand_match acceptance decision on realistic noisy pairs.
+"""
+
+import numpy as np
+import pytest
+
+from ccsx_tpu.config import AlignParams
+from ccsx_tpu.ops import banded, encode as enc, oracle
+from ccsx_tpu.utils import synth
+
+P = AlignParams()
+SCORES = dict(match=P.match, mismatch=P.mismatch,
+              gap_open=P.gap_open, gap_extend=P.gap_extend)
+
+
+def _pad(x, n):
+    out = np.full(n, banded.PAD, dtype=np.uint8)
+    out[: len(x)] = x
+    return out
+
+
+def run_one(q, t, mode, qmax=None, tmax=None, **kw):
+    # pad to canonical shapes: distinct shapes trigger fresh jit compiles
+    qmax = qmax or max(128, -(-len(q) // 128) * 128)
+    tmax = tmax or max(128, -(-len(t) // 128) * 128)
+    res = banded.banded_align(
+        _pad(q, qmax), np.int32(len(q)), _pad(t, tmax), np.int32(len(t)),
+        mode=mode, **kw,
+    )
+    return {k: int(v) for k, v in res._asdict().items()}
+
+
+@pytest.mark.parametrize("mode", ["global", "qfree", "local"])
+def test_scores_match_oracle_random(mode, rng):
+    for trial in range(15):
+        Q = int(rng.integers(3, 100))
+        T = int(rng.integers(3, 100))
+        q = rng.integers(0, 4, Q).astype(np.uint8)
+        t = rng.integers(0, 4, T).astype(np.uint8)
+        want = oracle.align(q, t, mode=mode, **SCORES)
+        got = run_one(q, t, mode)
+        assert got["score"] == want.score, (mode, trial, Q, T)
+
+
+@pytest.mark.parametrize("mode", ["global", "qfree", "local"])
+def test_scores_match_oracle_related(mode, rng):
+    """Pairs that are actual noisy copies (the realistic regime)."""
+    for trial in range(10):
+        t = rng.integers(0, 4, int(rng.integers(50, 150))).astype(np.uint8)
+        q = synth.mutate(rng, t, 0.03, 0.05, 0.05)
+        want = oracle.align(q, t, mode=mode, **SCORES)
+        got = run_one(q, t, mode)
+        assert got["score"] == want.score, (mode, trial)
+
+
+def test_padding_invariance(rng):
+    q = rng.integers(0, 4, 40).astype(np.uint8)
+    t = rng.integers(0, 4, 50).astype(np.uint8)
+    base = run_one(q, t, "global")
+    padded = run_one(q, t, "global", qmax=96, tmax=130)
+    assert base == padded
+
+
+def test_global_identical_stats():
+    q = enc.encode("ACGTACGTACGTACGT")
+    got = run_one(q, q, "global")
+    assert got["score"] == 32
+    assert got["mat"] == 16 and got["aln"] == 16
+
+
+def test_global_stats_with_gap(rng):
+    t = rng.integers(0, 4, 60).astype(np.uint8)
+    q = np.delete(t, [20, 21])  # two template-only bases
+    want = oracle.align(q, t, mode="global", **SCORES)
+    got = run_one(q, t, "global")
+    assert got["score"] == want.score
+    assert got["mat"] == want.mat
+    assert got["aln"] == want.aln
+
+
+def test_qfree_clip_span(rng):
+    t = rng.integers(0, 4, 90).astype(np.uint8)
+    junk1 = rng.integers(0, 4, 40).astype(np.uint8)
+    junk2 = rng.integers(0, 4, 35).astype(np.uint8)
+    q = np.concatenate([junk1, t, junk2])
+    want = oracle.align(q, t, mode="qfree", **SCORES)
+    got = run_one(q, t, "qfree")
+    assert got["score"] == want.score
+    assert abs(got["qb"] - want.qb) <= 2
+    assert abs(got["qe"] - want.qe) <= 2
+
+
+def test_local_span(rng):
+    core = rng.integers(0, 4, 60).astype(np.uint8)
+    q = np.concatenate([rng.integers(0, 4, 25).astype(np.uint8), core])
+    t = np.concatenate([core, rng.integers(0, 4, 20).astype(np.uint8)])
+    want = oracle.align(q, t, mode="local", **SCORES)
+    got = run_one(q, t, "local")
+    assert got["score"] == want.score
+    assert got["mat"] >= want.mat - 2
+
+
+def test_strand_match_decision_parity(rng):
+    """The accept/reject decision (main.c:280) must agree with the oracle."""
+    for trial in range(8):
+        z = synth.make_zmw(rng, template_len=200, n_passes=2, first_strand=0)
+        fwd, rev = z.passes[0], z.passes[1]
+        for q in (fwd, enc.revcomp_codes(rev), rev):
+            ok_oracle, _ = oracle.strand_match_oracle(q, z.template, 75, **SCORES)
+            got = run_one(q, z.template, "local", qmax=512, tmax=256)
+            ok_banded = (
+                got["aln"] * 2 > min(len(q), len(z.template))
+                and got["mat"] * 100 >= got["aln"] * 75
+            )
+            assert ok_banded == ok_oracle, trial
+
+
+def test_batch_vmap_matches_single(rng):
+    qs, ts, qlens, tlens = [], [], [], []
+    QM, TM = 80, 80
+    for _ in range(6):
+        Q = int(rng.integers(10, QM))
+        T = int(rng.integers(10, TM))
+        q = rng.integers(0, 4, Q).astype(np.uint8)
+        t = rng.integers(0, 4, T).astype(np.uint8)
+        qs.append(_pad(q, QM))
+        ts.append(_pad(t, TM))
+        qlens.append(Q)
+        tlens.append(T)
+    f = banded.make_batched("global", P)
+    res = f(np.stack(qs), np.array(qlens, np.int32),
+            np.stack(ts), np.array(tlens, np.int32))
+    for b in range(6):
+        single = run_one(qs[b][: qlens[b]], ts[b][: tlens[b]], "global")
+        assert int(res.score[b]) == single["score"]
+
+
+def test_long_band_limited(rng):
+    """Long related pair: banded score must equal oracle (band tracks path)."""
+    t = rng.integers(0, 4, 600).astype(np.uint8)
+    q = synth.mutate(rng, t, 0.02, 0.05, 0.05)
+    want = oracle.align(q, t, mode="global", **SCORES)
+    got = run_one(q, t, "global")
+    assert got["score"] == want.score
+
+
+def test_qfree_junk_suffix_long_template(rng):
+    """Regression: template longer than the band, query = template + junk
+    suffix — the slope-1 qfree line must keep column tlen reachable at the
+    true end row (was badly wrong with the corner-to-corner line)."""
+    t = rng.integers(0, 4, 300).astype(np.uint8)
+    q = np.concatenate([t, rng.integers(0, 4, 500).astype(np.uint8)])
+    want = oracle.align(q, t, mode="qfree", **SCORES)
+    got = run_one(q, t, "qfree", qmax=896, tmax=384)
+    assert got["score"] == want.score
+    assert abs(got["qe"] - want.qe) <= 2
+
+
+def test_global_unreachable_band_returns_sentinel():
+    """Regression: if the band cannot geometrically reach column tlen the
+    result must be the NEG sentinel, not a plausible-looking interior cell."""
+    q = np.zeros(10, dtype=np.uint8)
+    t = np.tile(np.arange(4, dtype=np.uint8), 150)  # tlen=600 >> qlen*maxshift
+    got = run_one(q, t, "global", qmax=128, tmax=640)
+    assert got["score"] == banded.NEG
+    assert got["aln"] == 0 and got["mat"] == 0
+
+
+def test_params_band_is_respected(rng):
+    """AlignParams.band must be the default band width."""
+    t = rng.integers(0, 4, 50).astype(np.uint8)
+    q = synth.mutate(rng, t, 0.03, 0.03, 0.03)
+    narrow = AlignParams(band=16)
+    res = banded.banded_align(
+        _pad(q, 128), np.int32(len(q)), _pad(t, 128), np.int32(len(t)),
+        mode="global", params=narrow)
+    # with band 16 the fill still works on near-diagonal pairs
+    want = oracle.align(q, t, mode="global", **SCORES)
+    assert int(res.score) == want.score

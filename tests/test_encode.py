@@ -1,0 +1,38 @@
+import numpy as np
+
+from ccsx_tpu.ops import encode as enc
+
+
+def test_encode_decode_roundtrip():
+    s = "ACGTACGT"
+    codes = enc.encode(s)
+    assert codes.tolist() == [0, 1, 2, 3, 0, 1, 2, 3]
+    assert enc.decode(codes) == s
+
+
+def test_encode_lowercase_and_n():
+    codes = enc.encode("acgtNX")
+    assert codes.tolist() == [0, 1, 2, 3, 4, 4]
+
+
+def test_revcomp_ascii():
+    assert enc.revcomp_ascii(b"ACGT") == b"ACGT"
+    assert enc.revcomp_ascii(b"AACG") == b"CGTT"
+    assert enc.revcomp_ascii(b"acgN") == b"Ncgt"
+
+
+def test_revcomp_codes():
+    codes = enc.encode("AACG")
+    rc = enc.revcomp_codes(codes)
+    assert enc.decode(rc) == "CGTT"
+    # involution
+    assert np.array_equal(enc.revcomp_codes(rc), codes)
+    # N fixed point
+    assert enc.revcomp_codes(np.array([4], dtype=np.uint8)).tolist() == [4]
+
+
+def test_revcomp_matches_ascii_path():
+    rng = np.random.default_rng(1)
+    codes = rng.integers(0, 4, 100).astype(np.uint8)
+    via_ascii = enc.encode(enc.revcomp_ascii(enc.decode(codes).encode()))
+    assert np.array_equal(enc.revcomp_codes(codes), via_ascii)

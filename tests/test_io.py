@@ -1,0 +1,126 @@
+import gzip
+import io
+
+import numpy as np
+import pytest
+
+from ccsx_tpu.config import CcsConfig
+from ccsx_tpu.io import fastx, zmw
+
+
+FASTA = b""">m0/1/0_10 comment here
+ACGTACGTAC
+>m0/1/10_15
+ACG
+TA
+>m0/2/0_4
+GGGG
+>m1/2/0_4
+TTTT
+"""
+
+FASTQ = b"""@m0/1/0_10
+ACGTACGTAC
++
+IIIIIIIIII
+@m0/1/10_14
+ACGT
++anything
+IIII
+"""
+
+
+def test_fasta_records():
+    recs = list(fastx.read_fastx(io.BufferedReader(io.BytesIO(FASTA))))
+    assert [r.name for r in recs] == ["m0/1/0_10", "m0/1/10_15", "m0/2/0_4", "m1/2/0_4"]
+    assert recs[0].comment == "comment here"
+    assert recs[0].seq == b"ACGTACGTAC"
+    assert recs[1].seq == b"ACGTA"  # multi-line sequence
+    assert recs[0].qual is None
+
+
+def test_fastq_records():
+    recs = list(fastx.read_fastx(io.BufferedReader(io.BytesIO(FASTQ))))
+    assert len(recs) == 2
+    assert recs[0].qual == b"IIIIIIIIII"
+    assert recs[1].seq == b"ACGT" and recs[1].qual == b"IIII"
+
+
+def test_fastq_bad_quality_length():
+    bad = b"@m0/1/0_4\nACGT\n+\nII\n"
+    with pytest.raises(ValueError):
+        list(fastx.read_fastx(io.BufferedReader(io.BytesIO(bad))))
+
+
+def test_gzip_transparent(tmp_path):
+    p = tmp_path / "x.fa.gz"
+    p.write_bytes(gzip.compress(FASTA))
+    recs = list(fastx.read_fastx(p))
+    assert len(recs) == 4
+
+
+def test_group_zmws():
+    recs = list(fastx.read_fastx(io.BufferedReader(io.BytesIO(FASTA))))
+    zs = list(zmw.group_zmws(recs))
+    # same hole id '2' under different movies must NOT merge (seqio.h:183)
+    assert [(z.movie, z.hole) for z in zs] == [("m0", "1"), ("m0", "2"), ("m1", "2")]
+    z0 = zs[0]
+    assert z0.n_passes == 2
+    assert z0.seqs == b"ACGTACGTACACGTA"
+    assert z0.lens.tolist() == [10, 5]
+    assert z0.offs.tolist() == [0, 10]
+    assert z0.subread(1) == b"ACGTA"
+
+
+def test_invalid_name_raises():
+    recs = [fastx.FastxRecord("badname", "", b"ACGT", None)]
+    with pytest.raises(zmw.InvalidZmwName):
+        list(zmw.group_zmws(recs))
+    recs = [fastx.FastxRecord("a/b/c/d", "", b"ACGT", None)]
+    with pytest.raises(zmw.InvalidZmwName):
+        list(zmw.group_zmws(recs))
+
+
+def _mk(n_passes, total=6000, hole="7"):
+    per = total // n_passes
+    seqs = b"A" * total
+    lens = np.full(n_passes, per, dtype=np.int32)
+    lens[-1] += total - per * n_passes
+    offs = np.zeros(n_passes, dtype=np.int32)
+    np.cumsum(lens[:-1], out=offs[1:])
+    return zmw.Zmw("m0", hole, seqs, lens, offs)
+
+
+def test_zmw_filter_count_and_len():
+    cfg = CcsConfig()
+    # count >= min_fulllen_count + 2 == 5 (main.c:659)
+    assert not zmw.zmw_filter(_mk(4), cfg)
+    assert zmw.zmw_filter(_mk(5), cfg)
+    # total length window [5000, 500000] (main.c:662-664)
+    assert not zmw.zmw_filter(_mk(5, total=4999), cfg)
+    assert zmw.zmw_filter(_mk(5, total=5000), cfg)
+    assert not zmw.zmw_filter(_mk(5, total=500001), cfg)
+
+
+def test_zmw_filter_exclusion():
+    cfg = CcsConfig(exclude_holes=frozenset({"7"}))
+    assert not zmw.zmw_filter(_mk(5, hole="7"), cfg)
+    assert zmw.zmw_filter(_mk(5, hole="8"), cfg)
+
+
+def test_gzip_bytesio_stream():
+    """Regression: raw BytesIO (no peek()) carrying gzip data must be
+    detected and decompressed, not silently parsed as binary junk."""
+    import io as _io
+    recs = list(fastx.read_fastx(_io.BytesIO(gzip.compress(FASTA))))
+    assert len(recs) == 4
+
+
+def test_plus_line_after_fasta_record():
+    """kseq parity: '+' after a '>' record starts a quality section (kseq.h:196)
+    — it must not yield a phantom empty-name record."""
+    import io as _io
+    data = b">r/1/0_4\nACGT\n+\nIIII\n>r/2/0_4\nTTTT\n"
+    recs = list(fastx.read_fastx(_io.BytesIO(data)))
+    assert [r.name for r in recs] == ["r/1/0_4", "r/2/0_4"]
+    assert recs[0].qual is None  # quality consumed but not reported for FASTA

@@ -1,0 +1,128 @@
+import numpy as np
+import pytest
+
+from ccsx_tpu.ops import encode as enc
+from ccsx_tpu.ops import oracle
+from ccsx_tpu.utils import synth
+
+
+def _cigar_consumes(rs):
+    qc = sum(l for op, l in rs.cigar if op in "MI")
+    tc = sum(l for op, l in rs.cigar if op in "MD")
+    return qc, tc
+
+
+def test_global_identical():
+    q = enc.encode("ACGTACGTAC")
+    rs = oracle.align(q, q, mode="global")
+    assert rs.mat == 10 and rs.mis == 0 and rs.ins == 0 and rs.del_ == 0
+    assert rs.score == 20
+    assert rs.qb == 0 and rs.qe == 10 and rs.tb == 0 and rs.te == 10
+
+
+def test_global_single_mismatch():
+    q = enc.encode("ACGTACGTAC")
+    t = q.copy()
+    t[4] = (t[4] + 1) % 4
+    rs = oracle.align(q, t, mode="global")
+    assert rs.mat == 9 and rs.mis == 1
+    assert rs.score == 9 * 2 - 6
+
+
+def test_global_gap_costs():
+    q = enc.encode("ACGTACGTAC")
+    t = np.concatenate([q[:5], q[7:]])  # delete 2 bases from template
+    rs = oracle.align(q, t, mode="global")
+    assert rs.ins == 2  # two query-only bases
+    assert rs.score == 8 * 2 + (-3 + 2 * -2)
+
+
+def test_traceback_consumes_spans():
+    rng = np.random.default_rng(2)
+    for _ in range(10):
+        q = rng.integers(0, 4, rng.integers(5, 60)).astype(np.uint8)
+        t = rng.integers(0, 4, rng.integers(5, 60)).astype(np.uint8)
+        for mode in ("global", "qfree", "local"):
+            rs = oracle.align(q, t, mode=mode)
+            qc, tc = _cigar_consumes(rs)
+            assert qc == rs.qe - rs.qb
+            assert tc == rs.te - rs.tb
+            assert rs.aln == rs.mat + rs.mis + rs.ins + rs.del_
+            if mode == "global":
+                assert (rs.qb, rs.qe, rs.tb, rs.te) == (0, len(q), 0, len(t))
+
+
+def test_qfree_clips_query():
+    rng = np.random.default_rng(3)
+    t = rng.integers(0, 4, 80).astype(np.uint8)
+    junk1 = rng.integers(0, 4, 30).astype(np.uint8)
+    junk2 = rng.integers(0, 4, 25).astype(np.uint8)
+    q = np.concatenate([junk1, t, junk2])
+    rs = oracle.align(q, t, mode="qfree")
+    assert rs.tb == 0 and rs.te == 80
+    # clipped query span should recover the embedded template closely
+    assert abs(rs.qb - 30) <= 3 and abs(rs.qe - 110) <= 3
+    assert rs.identity > 0.9
+
+
+def test_local_finds_common_core():
+    rng = np.random.default_rng(4)
+    core = rng.integers(0, 4, 50).astype(np.uint8)
+    q = np.concatenate([rng.integers(0, 4, 20).astype(np.uint8), core])
+    t = np.concatenate([core, rng.integers(0, 4, 15).astype(np.uint8)])
+    rs = oracle.align(q, t, mode="local")
+    assert rs.mat >= 45
+    assert rs.qb >= 15 and rs.te <= 55
+
+
+def test_strand_match_oracle_accepts_same_strand():
+    rng = np.random.default_rng(5)
+    z = synth.make_zmw(rng, template_len=300, n_passes=2, first_strand=0)
+    fwd = z.passes[0]
+    rev = z.passes[1]  # reverse strand pass
+    ok, rs = oracle.strand_match_oracle(fwd, z.template, 75)
+    assert ok and rs.identity >= 0.85
+    ok_rc, _ = oracle.strand_match_oracle(enc.revcomp_codes(rev), z.template, 75)
+    assert ok_rc
+    ok_wrong, _ = oracle.strand_match_oracle(rev, z.template, 75)
+    assert not ok_wrong
+
+
+def test_projection_roundtrip_identical():
+    q = enc.encode("ACGTACGT")
+    rs = oracle.align(q, q, mode="global")
+    aligned, ins_len, ins_bases, covered = oracle.project_to_template(rs, q, len(q))
+    assert np.array_equal(aligned, q)
+    assert ins_len.sum() == 0
+    assert covered.all()
+
+
+def test_projection_insertion_and_deletion():
+    t = enc.encode("ACGTACGT")
+    # query: insert two bases after template pos 3, delete template pos 6
+    q = np.concatenate([t[:4], enc.encode("GG"), t[4:6], t[7:]])
+    rs = oracle.align(q, t, mode="global")
+    aligned, ins_len, ins_bases, covered = oracle.project_to_template(rs, q, len(t))
+    assert ins_len.sum() == 2
+    assert (aligned == 4).sum() == 1
+    # non-gap cells must equal the template where no errors were introduced
+    assert np.array_equal(aligned[:4], t[:4])
+
+
+def test_projection_query_base_conservation():
+    rng = np.random.default_rng(6)
+    t = rng.integers(0, 4, 120).astype(np.uint8)
+    q = synth.mutate(rng, t, 0.05, 0.05, 0.05)
+    rs = oracle.align(q, t, mode="global")
+    aligned, ins_len, ins_bases, covered = oracle.project_to_template(rs, q, len(t))
+    consumed = int((aligned < 4).sum() + ins_len.sum())
+    assert consumed == len(q)
+
+
+@pytest.mark.parametrize("n_passes", [3, 5])
+def test_synth_passes_identity(n_passes):
+    rng = np.random.default_rng(7)
+    z = synth.make_zmw(rng, template_len=200, n_passes=n_passes)
+    for p, strand in zip(z.passes, z.strands):
+        oriented = enc.revcomp_codes(p) if strand else p
+        assert synth.identity(oriented, z.template) > 0.8
