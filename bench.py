@@ -1,0 +1,103 @@
+"""Benchmark: batched star-MSA consensus round throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The measured unit is ZMW-windows consensed per second by the batched device
+round (banded DP fill + traceback projection + column vote over a
+(Z, P, W) batch) — the hot compute of the pipeline (reference: the bsalign
+POA inside ccs_for2's window loop, main.c:552-572, where ~all CPU time
+goes; SURVEY.md §3.3).
+
+vs_baseline compares against the single-core CPU (XLA-CPU) number recorded
+in bench_baseline.json.  The reference binary itself is not buildable here
+(its bsalign dependency is cloned at build time, README.md:11 — no network),
+so the stored CPU run of this same workload is the baseline.
+Recalibrate with:  python bench.py --calibrate
+"""
+
+import json
+import os
+import sys
+import time
+
+# benchmark shapes (kept canonical so compiles cache): Z zmws x P passes x W window
+Z, P, W, TLEN = 16, 8, 1024, 1000
+WARMUP, ITERS = 2, 8
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "bench_baseline.json")
+
+
+def measure():
+    import jax
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from ccsx_tpu.config import AlignParams
+    from ccsx_tpu.ops import banded, msa, traceback
+    import __graft_entry__ as ge
+
+    params = AlignParams()
+    projector = traceback.make_projector(W, 4)
+    voter = msa.make_voter(4)
+
+    import functools
+
+    align_one = functools.partial(
+        banded.banded_align, mode="global", params=params, with_moves=True)
+
+    @jax.jit
+    def step(qs, qlens, ts, tlens, row_mask):
+        f = jax.vmap(jax.vmap(align_one, in_axes=(0, 0, None, None)),
+                     in_axes=(0, 0, 0, 0))
+        _, moves, offs = f(qs, qlens, ts, tlens)
+        proj = jax.vmap(jax.vmap(projector, in_axes=(0, 0, 0, 0, None)),
+                        in_axes=(0, 0, 0, 0, 0))
+        aligned, ins_cnt, ins_b, _lead = proj(moves, offs, qs, qlens, tlens)
+        cons, ins_base, ins_votes, ncov, match = jax.vmap(voter)(
+            aligned, ins_cnt, ins_b, row_mask)
+        return cons, ncov
+
+    args = ge._example_batch(Z=Z, P=P, W=W, tlen=TLEN)
+    for _ in range(WARMUP):
+        jax.block_until_ready(step(*args))
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        jax.block_until_ready(step(*args))
+    dt = (time.perf_counter() - t0) / ITERS
+    return Z / dt  # ZMW-windows per second
+
+
+def main():
+    calibrate = "--calibrate" in sys.argv
+    if calibrate:
+        # the baseline is the single-core XLA-CPU run of this workload;
+        # the axon plugin overrides JAX_PLATFORMS, so force via config
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    value = measure()
+
+    baseline = None
+    if os.path.exists(BASELINE_PATH):
+        with open(BASELINE_PATH) as f:
+            baseline = json.load(f).get("zmw_windows_per_sec")
+    if calibrate:
+        with open(BASELINE_PATH, "w") as f:
+            json.dump({"zmw_windows_per_sec": value,
+                       "note": "single-core XLA-CPU, shapes "
+                               f"Z={Z} P={P} W={W}"}, f, indent=1)
+        baseline = value
+
+    import jax
+    print(json.dumps({
+        "metric": "consensus round throughput "
+                  f"(Z={Z} zmw x P={P} passes x W={W} window, "
+                  f"backend={jax.default_backend()})",
+        "value": round(value, 3),
+        "unit": "zmw_windows/s",
+        "vs_baseline": round(value / baseline, 3) if baseline else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+"""ccsx-compatible CLI (reference: main.c:723-870).
+
+Same flags and conventions as the reference's getopt loop
+("hm:M:c:j:X:PAv", main.c:758): positional INPUT OUTPUT with '-'/stdin/
+stdout, -A for FASTA/Q, -P for whole-read (primitive) mode, -X hole
+exclusion, -c >= 3 enforced.  TPU-era extensions are long options.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional
+
+from ccsx_tpu.config import CcsConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ccsx-tpu",
+        description="Generate circular consensus sequences (ccs) from subreads.",
+    )
+    p.add_argument("input", nargs="?", default="-",
+                   help="Input file (BAM, or FASTA/Q with -A); '-' = stdin")
+    p.add_argument("output", nargs="?", default="-",
+                   help="Output FASTA; '-' = stdout")
+    p.add_argument("-m", type=int, default=5000, dest="min_len",
+                   help="Minimum total length of subreads in a hole [5000]")
+    p.add_argument("-M", type=int, default=500000, dest="max_len",
+                   help="Maximum total length of subreads in a hole [500000]")
+    p.add_argument("-c", type=int, default=3, dest="min_count",
+                   help="Minimum number of subreads required [3]")
+    p.add_argument("-A", action="store_true", dest="fastx",
+                   help="Input is fasta/fastq (gzip allowed)")
+    p.add_argument("-P", action="store_true", dest="primitive",
+                   help="Whole-read consensus (no windowed shred)")
+    p.add_argument("-X", default=None, dest="exclude",
+                   help="Exclude ZMWs: comma-separated hole IDs")
+    p.add_argument("-j", type=int, default=1, dest="threads",
+                   help="Number of host worker threads [1]")
+    p.add_argument("-v", action="count", default=0, dest="verbose",
+                   help="Debug verbosity (repeatable)")
+    # TPU-era extensions
+    p.add_argument("--device", default="auto", choices=["auto", "tpu", "cpu"])
+    p.add_argument("--refine-iters", type=int, default=2)
+    p.add_argument("--max-passes", type=int, default=32)
+    p.add_argument("--batch", action="store_true",
+                   help="Use the batched device pipeline (default when TPU)")
+    p.add_argument("--journal", default=None,
+                   help="Progress journal path for resumable runs")
+    return p
+
+
+def config_from_args(args) -> CcsConfig:
+    if args.min_count < 3:
+        # mirror main.c:786-789
+        print(f"Error! min fulllen count=[{args.min_count}] (>=3) !",
+              file=sys.stderr)
+        raise SystemExit(-1)
+    exclude = None
+    if args.exclude:
+        exclude = frozenset(x for x in args.exclude.split(",") if x)
+    return CcsConfig(
+        min_subread_len=args.min_len,
+        max_subread_len=args.max_len,
+        min_fulllen_count=args.min_count,
+        split_subread=not args.primitive,
+        is_bam=not args.fastx,
+        exclude_holes=exclude,
+        threads=args.threads,
+        verbose=args.verbose,
+        refine_iters=args.refine_iters,
+        max_passes=args.max_passes,
+        device=args.device,
+    )
+
+
+def main(argv: Optional[list] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        cfg = config_from_args(args)
+    except SystemExit as e:
+        return int(e.code or 0)
+
+    if args.batch:
+        print("[ccsx-tpu] --batch: batched device pipeline not wired into "
+              "the CLI yet; running the per-hole path", file=sys.stderr)
+
+    # imports deferred so --help stays fast and backend selection happens
+    # after the config is known
+    from ccsx_tpu.pipeline.run import run_pipeline
+
+    return run_pipeline(args.input, args.output, cfg,
+                        journal_path=args.journal)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,7 +62,8 @@ class CcsConfig:
     max_window: int = 8192             # growth cap before force-flush (TPU memory bound)
 
     # ---- consensus redesign knobs (no reference equivalent) ----
-    refine_iters: int = 1              # realign-to-draft refinement rounds
+    refine_iters: int = 2              # realign-to-draft refinement rounds;
+    #   intermediate rounds use liberal-insert/strict-delete (ops/msa.py)
     max_ins_per_col: int = 4           # inserted bases stored per (pass, template col)
 
     # ---- alignment scoring ----

@@ -1,0 +1,70 @@
+"""Host-facing aligner: seeds on the host, fills on the device.
+
+Bridges the irregular, per-pair host logic (ccs_prepare's strand_match calls,
+main.c:255-290) and the static-shape device DP: k-mer diagonal seeding
+(ops/seed.py) produces the nominal-line hint, sequences are padded to
+quantized shapes so XLA compilations are reused, and the acceptance rule is
+the reference's (main.c:280).
+
+This is the *scalar* path used by prepare; batched dispatch over whole
+chunks lives in consensus/runner.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ccsx_tpu.config import AlignParams
+from ccsx_tpu.consensus.star import pad_to, quantize_len
+from ccsx_tpu.ops import banded, seed
+
+
+@dataclasses.dataclass
+class MatchResult:
+    ok: bool
+    score: int
+    qb: int
+    qe: int
+    tb: int
+    te: int
+    aln: int
+    mat: int
+
+
+class HostAligner:
+    """strand_match with the reference's acceptance rule (main.c:280):
+    accept iff aln*2 > min(qlen, tlen) and mat*100 >= aln*similarity_pct."""
+
+    def __init__(self, params: AlignParams = AlignParams(), quant: int = 512):
+        self.params = params
+        self.quant = quant
+
+    def _run(self, q: np.ndarray, t: np.ndarray,
+             line: Optional[np.ndarray]) -> banded.BandedResult:
+        qp = pad_to(q, quantize_len(len(q), self.quant))
+        tp = pad_to(t, quantize_len(len(t), self.quant))
+        return banded.banded_align(
+            qp, np.int32(len(q)), tp, np.int32(len(t)),
+            mode="local", params=self.params,
+            line=None if line is None else np.asarray(line, np.int32),
+        )
+
+    def strand_match(self, q: np.ndarray, t: np.ndarray,
+                     similarity_pct: int) -> Tuple[bool, MatchResult]:
+        hit = seed.seed_diagonal(q, t)
+        if hit is None:
+            # no shared 13-mers at all: unalignable at >=60% identity
+            return False, MatchResult(False, 0, 0, 0, 0, 0, 0, 0)
+        # near-diagonal pairs don't need the hint; off-diagonal ones do
+        line = hit.line if abs(hit.diag) > self.params.band // 4 else None
+        res = self._run(q, t, line)
+        rs = MatchResult(
+            ok=False, score=int(res.score), qb=int(res.qb), qe=int(res.qe),
+            tb=int(res.tb), te=int(res.te), aln=int(res.aln), mat=int(res.mat),
+        )
+        rs.ok = (rs.aln * 2 > min(len(q), len(t))) and (
+            rs.mat * 100 >= rs.aln * similarity_pct)
+        return rs.ok, rs

@@ -1,0 +1,211 @@
+"""Pass orientation and template selection (host side).
+
+Re-implements the semantics of the reference's prepare stage
+(main.c:116-453): length clustering at 10% tolerance, template-group
+selection with the palindrome/adapter border check, and the outward
+orientation walk that alternates expected strand, verifies/clips doubtful
+passes by alignment against the template, and keeps only passes whose
+clipped length stays in the template length group.
+
+This is control-flow-heavy scalar work (SURVEY.md §7.3) — it stays on the
+host; only the pairwise alignments inside it run on the device (via
+HostAligner / the batched runner).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence
+
+import numpy as np
+
+from ccsx_tpu.config import CcsConfig
+from ccsx_tpu.ops import encode as enc
+
+
+@dataclasses.dataclass
+class LenGroup:
+    ids: List[int]
+    sum_len: int
+
+    @property
+    def size(self) -> int:
+        return len(self.ids)
+
+
+def len_in_group(g: LenGroup, length: int, tolerance_pct: int) -> bool:
+    """|len - mean| < tol% of mean, in integer arithmetic (main.c:124-129)."""
+    tmp = length * g.size
+    diff = abs(tmp - g.sum_len)
+    return diff * 100 < tolerance_pct * g.sum_len
+
+
+def group_in_group(a: LenGroup, b: LenGroup, tolerance_pct: int) -> bool:
+    """Means within tolerance (main.c:131-137)."""
+    ma = a.sum_len * b.size
+    mb = b.sum_len * a.size
+    return abs(ma - mb) * 100 < ma * tolerance_pct
+
+
+def group_lens(lens: Sequence[int], tolerance_pct: int) -> List[LenGroup]:
+    """Greedy length clustering + transitive merge + sort by size
+    (init_group_lens, main.c:139-212).  Member ids keep insertion order —
+    the "median member" picks ids[size//2] of that order, as the reference
+    does (main.c:317,364)."""
+    n = len(lens)
+    groups: List[LenGroup] = [LenGroup([], 0) for _ in range(n)]
+    for i in range(n):
+        placed = False
+        create_at = None
+        for j in range(n):
+            if groups[j].size == 0:
+                # first truly empty slot: create a new group here (the
+                # reference scans j<i then creates at the first free j)
+                create_at = j
+                break
+            if groups[j].sum_len == 0:
+                # zero-length-members group: unjoinable, skip — matches the
+                # reference's `if (!sum_len) continue` (main.c:150)
+                continue
+            if len_in_group(groups[j], int(lens[i]), tolerance_pct):
+                groups[j].ids.append(i)
+                groups[j].sum_len += int(lens[i])
+                placed = True
+                break
+        if not placed:
+            groups[create_at].ids.append(i)
+            groups[create_at].sum_len = int(lens[i])
+
+    # transitive merge (main.c:169-195)
+    changed = True
+    while changed:
+        changed = False
+        for j in range(n):
+            if groups[j].size == 0:
+                continue
+            for k in range(j):
+                if groups[k].size and group_in_group(groups[k], groups[j],
+                                                     tolerance_pct):
+                    groups[k].ids.extend(groups[j].ids)
+                    groups[k].sum_len += groups[j].sum_len
+                    groups[j] = LenGroup([], 0)
+                    changed = True
+                    break
+
+    out = [g for g in groups if g.size > 0]
+    out.sort(key=lambda g: -g.size)  # stable, like the bubble sort (main.c:208)
+    return out
+
+
+@dataclasses.dataclass
+class Segment:
+    """Oriented, clipped view into a ZMW's concatenated buffer
+    (segment_t, main.c:292-297)."""
+
+    offs: int
+    length: int
+    reverse: bool
+    pos: int = 0
+
+
+def get_template_grp(codes: np.ndarray, lens, offs, groups: List[LenGroup],
+                     aligner, cfg: CcsConfig) -> int:
+    """Template-group adjustment rejecting palindrome/adapter artifacts
+    (main.c:300-342): a larger-length candidate group is adopted unless the
+    reverse-complement of either 1000bp border matches the rest of the read
+    at 70% identity."""
+    template_grp = 0
+    if groups[0].size < 2:
+        return 0
+    bl = cfg.border_len
+    for cg in range(1, len(groups)):
+        g = groups[cg]
+        if g.size < 2 or g.size * 5 < 4 * groups[0].size:
+            continue
+        ci = g.ids[g.size // 2]
+        clen = int(lens[ci])
+        cur = groups[template_grp]
+        cur_med = int(lens[cur.ids[cur.size // 2]])
+        if clen <= cur_med or clen <= cfg.border_min_template:
+            continue
+        start = int(offs[ci])
+        read = codes[start:start + clen]
+        head_rc = enc.revcomp_codes(read[:bl])
+        if aligner.strand_match(head_rc, read[bl:], cfg.border_identity_pct)[0]:
+            continue  # palindromic head: artifact, keep current template
+        tail_rc = enc.revcomp_codes(read[clen - bl:])
+        if aligner.strand_match(tail_rc, read[:clen - bl],
+                                cfg.border_identity_pct)[0]:
+            continue
+        template_grp = cg
+    return template_grp
+
+
+def ccs_prepare(codes: np.ndarray, lens, offs, aligner,
+                cfg: CcsConfig) -> List[Segment]:
+    """The outward orientation walk (ccs_prepare, main.c:344-453).
+
+    Starting from the template pass, walk outward in both directions,
+    alternating the expected strand each step.  In-group passes are trusted
+    by parity until a mismatch event; out-of-group or doubtful passes are
+    aligned against the template (fwd then RC) at 75% identity, clipped to
+    the aligned query span, and kept only if the clipped length is still in
+    the template group.  Returns segments with the template first.
+    """
+    tol = cfg.group_tolerance_pct
+    groups = group_lens(lens, tol)
+    map_group = {}
+    for gi, g in enumerate(groups):
+        for i in g.ids:
+            map_group[i] = gi
+
+    template_grp = get_template_grp(codes, lens, offs, groups, aligner, cfg)
+    tg = groups[template_grp]
+    template_i = tg.ids[tg.size // 2]
+    template_offs = int(offs[template_i])
+    template_len = int(lens[template_i])
+    tseq = codes[template_offs:template_offs + template_len]
+    t2seq = enc.revcomp_codes(tseq)
+
+    segments = [Segment(template_offs, template_len, False)]
+
+    def walk(indices):
+        reverse = False
+        strand_adjust = False
+        for k in indices:
+            reverse = not reverse
+            seg = Segment(int(offs[k]), int(lens[k]), reverse)
+            if map_group[k] != template_grp:
+                strand_adjust = True
+                if seg.length < template_len:
+                    continue
+            elif not strand_adjust:
+                segments.append(seg)
+                continue
+            qseq = codes[seg.offs:seg.offs + seg.length]
+            ok_f, rs = aligner.strand_match(qseq, tseq, cfg.strand_identity_pct)
+            if ok_f:
+                reverse = False
+            else:
+                ok_r, rs = aligner.strand_match(qseq, t2seq,
+                                                cfg.strand_identity_pct)
+                if ok_r:
+                    reverse = True
+                else:
+                    strand_adjust = True
+                    continue
+            clipped = Segment(seg.offs + rs.qb, rs.qe - rs.qb, reverse)
+            if len_in_group(groups[template_grp], clipped.length, tol):
+                segments.append(clipped)
+            strand_adjust = map_group[k] != template_grp
+
+    walk(range(template_i - 1, -1, -1))
+    walk(range(template_i + 1, len(lens)))
+    return segments
+
+
+def oriented_pass(codes: np.ndarray, seg: Segment) -> np.ndarray:
+    """Extract a segment's bases, reverse-complemented when needed
+    (the in-place RC at main.c:471-480, done functionally here)."""
+    s = codes[seg.offs:seg.offs + seg.length]
+    return enc.revcomp_codes(s) if seg.reverse else s

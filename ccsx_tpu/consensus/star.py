@@ -1,0 +1,141 @@
+"""Shared star-MSA machinery: one alignment+projection+vote round.
+
+Both consensus paths build on this:
+  * whole-read (consensus/whole_read.py) loops rounds and materializes;
+  * windowed (consensus/windowed.py) additionally consumes the per-column
+    stats for breakpoint detection and cursor bookkeeping.
+
+A "round" aligns every pass (globally, banded) to the current draft,
+projects each alignment onto draft coordinates, and votes per column.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import List, Sequence
+
+import jax
+import numpy as np
+
+from ccsx_tpu.config import AlignParams
+from ccsx_tpu.ops import banded, msa, traceback
+
+
+def pass_bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+def quantize_len(n: int, q: int) -> int:
+    return max(q, -(-n // q) * q)
+
+
+def pad_to(x: np.ndarray, n: int) -> np.ndarray:
+    out = np.full(n, banded.PAD, np.uint8)
+    out[: len(x)] = x
+    return out
+
+
+@functools.lru_cache(maxsize=8)
+def _aligner(params: AlignParams):
+    # one jitted aligner per scoring config; shape specialization is
+    # handled by jit's own trace cache, so distinct (qmax, tmax) buckets
+    # reuse this callable instead of rebuilding it
+    return banded.make_batched("global", params, with_moves=True)
+
+
+@functools.lru_cache(maxsize=64)
+def _projector(tmax: int, max_ins: int):
+    projector = traceback.make_projector(tmax, max_ins)
+    return jax.jit(jax.vmap(projector, in_axes=(0, 0, 0, 0, None)))
+
+
+@functools.lru_cache(maxsize=8)
+def _voter(max_ins: int):
+    return msa.make_voter(max_ins)
+
+
+@dataclasses.dataclass
+class RoundResult:
+    """Device arrays from one star-MSA round (draft coordinates)."""
+
+    cons: np.ndarray      # (T,) uint8: 0-3 base, 4 gap
+    ins_base: np.ndarray  # (T, R) uint8 majority inserted base per slot/rank
+    ins_votes: np.ndarray  # (T, R) int32 supporting passes per slot/rank
+    ncov: np.ndarray      # (T,) int32 covering passes
+    match: np.ndarray     # (P, T) bool: pass matches consensus
+    aligned: np.ndarray   # (P, T) uint8 projection
+    ins_cnt: np.ndarray   # (P, T) int32 insertion counts (uncapped)
+    lead_ins: np.ndarray  # (P,) int32 query bases before column 0
+    tlen: int
+
+    def ins_out(self, speculative: bool = False) -> np.ndarray:
+        return msa.emit_insertions(self.ins_base, self.ins_votes,
+                                   self.ncov, speculative)
+
+    def materialize(self, upto: int | None = None,
+                    speculative: bool = False) -> np.ndarray:
+        n = self.tlen if upto is None else upto
+        return msa.materialize(self.cons, self.ins_out(speculative), n)
+
+
+class StarMsa:
+    def __init__(self, params: AlignParams, max_ins: int = 4,
+                 len_quant: int = 512):
+        self.params = params
+        self.max_ins = max_ins
+        self.len_quant = len_quant
+
+    def round(self, qs: np.ndarray, qlens: np.ndarray, row_mask: np.ndarray,
+              draft: np.ndarray) -> RoundResult:
+        """qs: (P, qmax) uint8 padded passes; draft: (tlen,) codes."""
+        P, qmax = qs.shape
+        tlen = len(draft)
+        tmax = quantize_len(tlen, self.len_quant)
+        aligner = _aligner(self.params)
+        projector_b = _projector(tmax, self.max_ins)
+        voter = _voter(self.max_ins)
+        ts = np.ascontiguousarray(
+            np.broadcast_to(pad_to(draft, tmax), (P, tmax)))
+        tlens = np.full(P, tlen, np.int32)
+        _, moves, offs = aligner(qs, qlens, ts, tlens)
+        aligned, ins_cnt, ins_b, lead_ins = projector_b(
+            moves, offs, qs, qlens, np.int32(tlen))
+        cons, ins_base, ins_votes, ncov, match = voter(
+            aligned, ins_cnt, ins_b, row_mask)
+        return RoundResult(
+            cons=np.asarray(cons), ins_base=np.asarray(ins_base),
+            ins_votes=np.asarray(ins_votes),
+            ncov=np.asarray(ncov), match=np.asarray(match),
+            aligned=np.asarray(aligned), ins_cnt=np.asarray(ins_cnt),
+            lead_ins=np.asarray(lead_ins), tlen=tlen,
+        )
+
+    def pack(self, passes: List[np.ndarray], pass_buckets: Sequence[int],
+             max_passes: int, qmax: int | None = None):
+        """Pad a pass list to (P, qmax) + lens + row mask."""
+        if len(passes) > max_passes:
+            passes = passes[:max_passes]
+        P = pass_bucket(len(passes), pass_buckets)
+        if qmax is None:
+            qmax = quantize_len(max(len(p) for p in passes), self.len_quant)
+        qs = np.stack(
+            [pad_to(p, qmax) for p in passes]
+            + [np.full(qmax, banded.PAD, np.uint8)] * (P - len(passes)))
+        qlens = np.array(
+            [len(p) for p in passes] + [0] * (P - len(passes)), np.int32)
+        return qs, qlens, qlens > 0
+
+    def consensus(self, passes: List[np.ndarray], iters: int,
+                  pass_buckets: Sequence[int], max_passes: int) -> np.ndarray:
+        """iters+1 rounds; intermediate rounds insert speculatively (see
+        msa.emit_insertions), the final round applies strict majority."""
+        qs, qlens, row_mask = self.pack(passes, pass_buckets, max_passes)
+        draft = passes[0]
+        for it in range(iters + 1):
+            rr = self.round(qs, qlens, row_mask, draft)
+            draft = rr.materialize(speculative=(it < iters))
+        return draft

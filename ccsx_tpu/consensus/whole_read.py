@@ -1,0 +1,40 @@
+"""Whole-read consensus — the reference's primitive `-P` path (ccs_for,
+main.c:455-508), redesigned as a template-anchored star MSA.
+
+The reference pushes all oriented passes into one POA graph and calls the
+graph consensus (beg/push/end_bspoa, main.c:486-492).  Here the template
+pass anchors a star MSA (consensus/star.py): banded global DP batched over
+passes, traceback projection onto anchor coordinates, column vote, and
+liberal-insert/strict-delete refinement rounds that recover the
+cross-pass insertion reinforcement a POA graph provides natively.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ccsx_tpu.config import CcsConfig
+from ccsx_tpu.consensus import prepare as prep
+from ccsx_tpu.consensus.star import StarMsa
+from ccsx_tpu.ops import encode as enc
+
+
+def consensus_passes(passes: List[np.ndarray], cfg: CcsConfig) -> np.ndarray:
+    """Consensus of oriented pass code arrays; passes[0] is the anchor."""
+    sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
+    return sm.consensus(passes, cfg.refine_iters, cfg.pass_buckets,
+                        cfg.max_passes)
+
+
+def ccs_whole_read(zmw, aligner, cfg: CcsConfig) -> Optional[bytes]:
+    """Full `-P` path for one ZMW (ccs_for, main.c:455-508): prepare ->
+    orient -> star-MSA consensus.  Returns ASCII consensus or None."""
+    if zmw.n_passes < 3:  # main.c:460
+        return None
+    codes = enc.encode(zmw.seqs)
+    segments = prep.ccs_prepare(codes, zmw.lens, zmw.offs, aligner, cfg)
+    passes = [prep.oriented_pass(codes, s) for s in segments]
+    cns = consensus_passes(passes, cfg)
+    return enc.decode(cns).encode()

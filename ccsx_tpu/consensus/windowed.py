@@ -1,0 +1,141 @@
+"""Windowed ("shred") consensus — the reference's default path
+(ccs_for2, main.c:510-647), the long-context strategy of this framework.
+
+The reference bounds POA size by consensing ~2kb windows per pass and
+re-synchronizing cursors at an agreement breakpoint (SURVEY.md §5.7).  We
+keep exactly that structure — it is what makes the kernel shapes static:
+
+  window loop (host):
+    slice window_size bases from each pass at its cursor
+    star-MSA rounds over the windows (anchor = template pass window)
+    scan for a breakpoint: `bp_window` consecutive MSA columns where the
+      consensus is a base, per-column agreement >= colrate% of passes,
+      >= minwin base columns, and EVERY pass matches in >= rowrate% of them
+      (main.c:580-612)
+    emit consensus columns before the breakpoint; advance each cursor by
+      the bases that pass consumed there (main.c:622-638)
+    no breakpoint -> grow the window by window_add (main.c:550) up to
+      max_window (we force a flush there instead of growing unboundedly —
+      a documented delta: the reference can grow without limit)
+    any pass nearly exhausted (pos + window + minlen >= len) or <3 passes
+      -> final flush of all tails (main.c:555-564)
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ccsx_tpu.config import CcsConfig
+from ccsx_tpu.consensus import prepare as prep
+from ccsx_tpu.consensus.star import RoundResult, StarMsa
+from ccsx_tpu.ops import encode as enc
+
+
+def _window_sums(x: np.ndarray, w: int) -> np.ndarray:
+    """Sliding sums of width w along the last axis: out[..., i] = sum x[..., i:i+w]."""
+    c = np.cumsum(x, axis=-1, dtype=np.int64)
+    pad = np.zeros(x.shape[:-1] + (1,), dtype=np.int64)
+    c = np.concatenate([pad, c], axis=-1)
+    return c[..., w:] - c[..., :-w]
+
+
+def find_breakpoint(rr: RoundResult, nseq: int, cfg: CcsConfig) -> Optional[int]:
+    """Vectorized equivalent of the reference's backward scan
+    (main.c:580-612) over template-anchored columns.  Returns the highest
+    valid breakpoint column i >= 1, or None."""
+    W = cfg.bp_window
+    T = rr.tlen
+    if T < W + 1:
+        return None
+    match = rr.match[:nseq, :T]  # real rows only — padding rows never match
+    isbase = (rr.cons[:T] < 4)
+    matchcnt = match.sum(0)
+    colrate = cfg.bp_colrate if nseq >= 10 else cfg.bp_colrate_lowpass
+    colok = matchcnt * 100 >= colrate * nseq
+    badbase = isbase & ~colok
+
+    nog = _window_sums(isbase.astype(np.int64), W)          # (T-W+1,)
+    bad = _window_sums(badbase.astype(np.int64), W)
+    rowin = _window_sums((match & isbase[None, :]).astype(np.int64), W)
+
+    valid = (bad == 0) & (nog >= cfg.bp_minwin) & isbase[: T - W + 1]
+    rows_ok = (rowin * 100 >= cfg.bp_rowrate * nog[None, :]).all(axis=0)
+    valid &= rows_ok
+    # candidates are i in [1, T-W] (the reference scans msa_size-W down to 1)
+    cand = np.nonzero(valid[1:])[0]
+    if len(cand) == 0:
+        return None
+    return int(cand[-1]) + 1
+
+
+def _advance(rr: RoundResult, bp: int) -> np.ndarray:
+    """Per-pass query bases consumed by columns [0, bp) — non-gap cells,
+    all insertions at slots < bp, and the leading insertions before
+    column 0 (main.c:622-638 bumps pos through every MSA cell)."""
+    nongap = (rr.aligned[:, :bp] < 4).sum(axis=1)
+    ins = rr.ins_cnt[:, :bp].sum(axis=1)
+    return (nongap + ins + rr.lead_ins).astype(np.int64)
+
+
+def consensus_windowed(passes: List[np.ndarray], cfg: CcsConfig) -> np.ndarray:
+    """Windowed consensus over oriented passes; passes[0] anchors."""
+    sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
+    if len(passes) > cfg.max_passes:
+        passes = passes[: cfg.max_passes]
+    nseq = len(passes)
+    pos = np.zeros(nseq, dtype=np.int64)
+    lens = np.array([len(p) for p in passes], dtype=np.int64)
+    out: List[np.ndarray] = []
+
+    flag = True
+    while flag:
+        window_size = cfg.window_init
+        while True:
+            fits = bool(
+                ((pos + window_size + cfg.window_minlen) < lens).all())
+            final = (not fits) or nseq < 3
+            if final:
+                windows = [p[int(pos[k]):] for k, p in enumerate(passes)]
+            else:
+                windows = [p[int(pos[k]):int(pos[k]) + window_size]
+                           for k, p in enumerate(passes)]
+            qs, qlens, row_mask = sm.pack(
+                windows, cfg.pass_buckets, cfg.max_passes)
+            draft = windows[0]
+            rr = None
+            for it in range(cfg.refine_iters + 1):
+                rr = sm.round(qs, qlens, row_mask, draft)
+                draft = rr.materialize(speculative=(it < cfg.refine_iters))
+
+            if final:
+                out.append(draft)
+                flag = False
+                break
+
+            bp = find_breakpoint(rr, nseq, cfg)
+            if bp is None and window_size + cfg.window_add <= cfg.max_window:
+                window_size += cfg.window_add
+                continue
+            if bp is None:
+                # growth cap reached: force a flush point (delta vs the
+                # reference's unbounded growth)
+                bp = max(rr.tlen - cfg.bp_window, 1)
+            out.append(rr.materialize(upto=bp))
+            pos += _advance(rr, bp)[:nseq]  # drop pass-bucket padding rows
+            break
+
+    return np.concatenate(out) if out else np.zeros(0, np.uint8)
+
+
+def ccs_windowed(zmw, aligner, cfg: CcsConfig) -> Optional[bytes]:
+    """Full default path for one ZMW (ccs_for2): prepare -> orient ->
+    windowed star consensus."""
+    if zmw.n_passes < 3:  # main.c:515
+        return None
+    codes = enc.encode(zmw.seqs)
+    segments = prep.ccs_prepare(codes, zmw.lens, zmw.offs, aligner, cfg)
+    passes = [prep.oriented_pass(codes, s) for s in segments]
+    cns = consensus_windowed(passes, cfg)
+    return enc.decode(cns).encode()

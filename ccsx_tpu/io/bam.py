@@ -1,0 +1,139 @@
+"""Minimal BAM reader over a plain gzip stream (Python fallback path).
+
+Replicates the semantics of the reference's bamlite (bamlite.c:78-165):
+BAM-through-gzip — BGZF files are valid multi-member gzip streams, so
+sequential reading works without BGZF block handling (bamlite.h:13-19 makes
+the same choice; no random access).  Per record we decode the read name,
+the 4-bit packed sequence via the =ACMGRSVTWYHKDBN table (seqio.h:92,
+bamlite.h:86) and qualities as phred+33 clamped at 126 (seqio.h:113).
+
+Truncated-stream handling mirrors bamlite: a clean EOF at a record boundary
+ends the stream; a partial record raises.
+"""
+
+from __future__ import annotations
+
+import gzip
+import io
+import struct
+from typing import Iterator
+
+import numpy as np
+
+from ccsx_tpu.io.fastx import FastxRecord
+
+SEQ_NT16 = b"=ACMGRSVTWYHKDBN"
+
+# 2x256 lookup: byte -> two ASCII bases (high nibble first, bamlite.h:86)
+_NIB = np.empty((256, 2), dtype=np.uint8)
+for _b in range(256):
+    _NIB[_b, 0] = SEQ_NT16[_b >> 4]
+    _NIB[_b, 1] = SEQ_NT16[_b & 0xF]
+
+
+class BamError(ValueError):
+    pass
+
+
+def _read_exact(f, n: int, what: str) -> bytes:
+    buf = f.read(n)
+    if len(buf) != n:
+        raise BamError(f"truncated BAM: short read in {what}")
+    return buf
+
+
+def read_bam_header(f) -> dict:
+    magic = _read_exact(f, 4, "magic")
+    if magic != b"BAM\x01":
+        raise BamError("invalid BAM header")  # bamlite.c:84
+    (l_text,) = struct.unpack("<i", _read_exact(f, 4, "l_text"))
+    text = _read_exact(f, l_text, "text").rstrip(b"\x00").decode(
+        errors="replace")
+    (n_ref,) = struct.unpack("<i", _read_exact(f, 4, "n_ref"))
+    refs = []
+    for _ in range(n_ref):
+        (l_name,) = struct.unpack("<i", _read_exact(f, 4, "ref name len"))
+        name = _read_exact(f, l_name, "ref name")[:-1].decode(errors="replace")
+        (l_ref,) = struct.unpack("<i", _read_exact(f, 4, "ref len"))
+        refs.append((name, l_ref))
+    return {"text": text, "refs": refs}
+
+
+def read_bam_records(path_or_file) -> Iterator[FastxRecord]:
+    """Stream BAM alignment records as FastxRecords (name/seq/qual)."""
+    if hasattr(path_or_file, "read"):
+        raw = path_or_file
+    else:
+        raw = open(path_or_file, "rb")
+    # transparent gzip/BGZF
+    if not hasattr(raw, "peek"):
+        raw = io.BufferedReader(raw)
+    if raw.peek(2)[:2] == b"\x1f\x8b":
+        f = io.BufferedReader(gzip.GzipFile(fileobj=raw))
+    else:
+        f = raw
+
+    read_bam_header(f)
+    while True:
+        head = f.read(4)
+        if len(head) == 0:
+            return  # clean EOF (bamlite.c:141 returns -1)
+        if len(head) < 4:
+            raise BamError("truncated BAM: partial block size")
+        (block_size,) = struct.unpack("<i", head)
+        block = _read_exact(f, block_size, "alignment block")
+        (refid, pos, l_read_name, mapq, bin_, n_cigar, flag, l_seq,
+         next_ref, next_pos, tlen) = struct.unpack("<iiBBHHHiiii", block[:32])
+        off = 32
+        name = block[off:off + l_read_name - 1].decode(errors="replace")
+        off += l_read_name
+        off += 4 * n_cigar
+        nseq_bytes = (l_seq + 1) // 2
+        packed = np.frombuffer(block, dtype=np.uint8,
+                               count=nseq_bytes, offset=off)
+        seq = _NIB[packed].reshape(-1)[:l_seq].tobytes()
+        off += nseq_bytes
+        qual_raw = np.frombuffer(block, dtype=np.uint8, count=l_seq,
+                                 offset=off)
+        # phred+33 clamped at 126 (seqio.h:113)
+        qual = np.minimum(qual_raw.astype(np.int16) + 33, 126).astype(
+            np.uint8).tobytes()
+        yield FastxRecord(name=name, comment="", seq=seq, qual=qual)
+
+
+def write_bam(path, records, refs=()) -> None:
+    """Tiny BAM writer for tests/fixtures (unmapped records only)."""
+    import zlib
+
+    out = io.BytesIO()
+    text = b"@HD\tVN:1.6\n"
+    out.write(b"BAM\x01")
+    out.write(struct.pack("<i", len(text)))
+    out.write(text)
+    out.write(struct.pack("<i", len(refs)))
+    for name, ln in refs:
+        nm = name.encode() + b"\x00"
+        out.write(struct.pack("<i", len(nm)))
+        out.write(nm)
+        out.write(struct.pack("<i", ln))
+    rev = {v: i for i, v in enumerate(SEQ_NT16)}
+    for name, seq, qual in records:
+        nm = name.encode() + b"\x00"
+        l_seq = len(seq)
+        packed = bytearray((l_seq + 1) // 2)
+        for i, b in enumerate(seq):
+            code = rev.get(b, 15)
+            if i % 2 == 0:
+                packed[i // 2] |= code << 4
+            else:
+                packed[i // 2] |= code
+        q = bytes((min(max(x - 33, 0), 93) for x in qual)) if qual \
+            else b"\xff" * l_seq
+        body = struct.pack("<iiBBHHHiiii", -1, -1, len(nm), 255, 0, 0, 4,
+                           l_seq, -1, -1, 0)
+        body += nm + bytes(packed) + q
+        out.write(struct.pack("<i", len(body)))
+        out.write(body)
+    data = out.getvalue()
+    with open(path, "wb") as fh:
+        fh.write(gzip.compress(data))

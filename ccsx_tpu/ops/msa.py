@@ -1,0 +1,93 @@
+"""Star-MSA column voting: consensus call over stacked projections.
+
+The reference's consensus is BSPOA's column/bundle majority over the POA MSA
+(g->cns consumed at main.c:495-501; MSA cells 0-3 base / >=4 gap at
+main.c:583-598).  Our MSA is the stack of template-anchored projections
+(ops/traceback.py): base columns are template columns, insertion columns are
+the per-slot insertion cells.  The vote is a pure elementwise reduction over
+the pass axis — ideal VPU work, shardable over passes with a psum.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+GAP = 4
+PAD = 5
+
+
+def make_voter(max_ins: int = 4):
+    """Jitted column vote.  Shapes: aligned (P, T), ins_cnt (P, T),
+    ins_b (P, T, R), row_mask (P,) bool.  Returns:
+      cons     (T,) uint8  — 0-3 base, 4 gap (column dropped)
+      ins_base (T, R) uint8 — majority inserted base per slot/rank (always
+                              computed; emission is the caller's threshold)
+      ins_votes(T, R) int32 — passes inserting at least r+1 bases at the slot
+      ncov     (T,) int32  — covering passes per column
+      match    (P, T) bool — pass agrees with consensus at base column
+    """
+
+    @jax.jit
+    def vote(aligned, ins_cnt, ins_b, row_mask):
+        mask = row_mask[:, None]
+        cnts = jnp.stack(
+            [((aligned == c) & mask).sum(0) for c in range(5)]
+        )  # (5, T): A C G T gap
+        ncov = cnts.sum(0)
+        cons = jnp.argmax(cnts, axis=0).astype(jnp.uint8)
+        cons = jnp.where(ncov == 0, jnp.uint8(GAP), cons)
+
+        bases, votes = [], []
+        for r in range(max_ins):
+            has = mask & (ins_cnt > r)
+            votes.append(has.sum(0))
+            bc = jnp.stack(
+                [((ins_b[:, :, r] == c) & has).sum(0) for c in range(4)]
+            )
+            bases.append(jnp.argmax(bc, axis=0).astype(jnp.uint8))
+        ins_base = jnp.stack(bases, axis=1)
+        ins_votes = jnp.stack(votes, axis=1)
+
+        match = (aligned == cons[None, :]) & mask
+        return cons, ins_base, ins_votes, ncov, match
+
+    return vote
+
+
+def emit_insertions(ins_base: np.ndarray, ins_votes: np.ndarray,
+                    ncov: np.ndarray, speculative: bool) -> np.ndarray:
+    """Decide which insertion cells become columns (host, NumPy).
+
+    Strict: a majority of covering passes insert at the slot (the POA
+    analog: the inserted bundle outweighs the gap bundle).
+
+    Speculative (intermediate refinement rounds): ALSO accept >=2-pass /
+    >=1/3 support.  Star MSAs split the votes for a base the draft is
+    missing across adjacent slots and substitution cells (unlike a POA
+    graph, where one inserted node accumulates all the weight); inserting
+    liberally turns the candidate into a *column*, whose vote next round
+    does not split — wrong speculations are then deleted by majority gap.
+    """
+    ins_base = np.asarray(ins_base)
+    ins_votes = np.asarray(ins_votes)
+    n = np.asarray(ncov)[:, None]
+    emit = ins_votes * 2 > n
+    if speculative:
+        emit |= ins_votes >= np.maximum(2, -(-n // 3))
+    # prefix rule: rank r only emits if rank r-1 did
+    emit = np.logical_and.accumulate(emit, axis=1)
+    return np.where(emit, ins_base, PAD).astype(np.uint8)
+
+
+def materialize(cons: np.ndarray, ins_out: np.ndarray, tlen: int) -> np.ndarray:
+    """Interleave base + insertion columns into the consensus sequence.
+
+    Host-side: output length is data-dependent.  Order: column j's base
+    (if not gap), then the insertions after column j.
+    """
+    cons = np.asarray(cons)[:tlen]
+    ins = np.asarray(ins_out)[:tlen]
+    m = np.concatenate([cons[:, None], ins], axis=1).ravel()
+    return m[m < 4].astype(np.uint8)

@@ -1,0 +1,99 @@
+"""Host-side k-mer diagonal seeding (NumPy).
+
+The reference's pairwise aligner is k-mer seeded
+(kmer_striped_seqedit_pairwise with k=13, main.c:264): shared 13-mers locate
+the alignment diagonal before the banded DP runs.  We keep that division of
+labor: seeding runs on the host (tiny, latency-bound, irregular — wrong shape
+for the TPU), and its output is the nominal-line hint consumed by the banded
+device kernel (ops/banded.py `line=`).
+
+Seeding is sort-join based: O((Q+T) log T) per pair, no hash tables.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+DEFAULT_K = 13          # main.c:264
+MAX_HITS_PER_KMER = 4   # repeat guard
+DIAG_BIN = 32           # diagonal histogram bin width
+
+
+class SeedHit(NamedTuple):
+    diag: int        # qpos - tpos of the dominant diagonal
+    votes: int       # supporting k-mer hits
+    line: np.ndarray  # (4,) int32 nominal line for banded_align
+
+
+def kmer_codes(seq: np.ndarray, k: int = DEFAULT_K) -> np.ndarray:
+    """Packed 2-bit k-mer codes; positions containing N yield code -1."""
+    seq = np.asarray(seq, dtype=np.int64)
+    n = len(seq) - k + 1
+    if n <= 0:
+        return np.empty(0, dtype=np.int64)
+    # rolling pack via strided cumulative shifts
+    codes = np.zeros(n, dtype=np.int64)
+    bad = np.zeros(n, dtype=bool)
+    for i in range(k):
+        w = seq[i:i + n]
+        codes = (codes << 2) | (w & 3)
+        bad |= w >= 4
+    codes[bad] = -1
+    return codes
+
+
+def seed_diagonal(
+    q: np.ndarray,
+    t: np.ndarray,
+    k: int = DEFAULT_K,
+    min_votes: int = 3,
+) -> Optional[SeedHit]:
+    """Find the dominant alignment diagonal (qpos - tpos) by k-mer voting.
+
+    Returns None when fewer than ``min_votes`` k-mer hits support any
+    diagonal band — the caller can reject the pair without running the DP
+    (the reference gets the same early-out from a seedless k-mer alignment).
+    """
+    qk = kmer_codes(q, k)
+    tk = kmer_codes(t, k)
+    if len(qk) == 0 or len(tk) == 0:
+        return None
+    order = np.argsort(tk, kind="stable")
+    tks = tk[order]
+    left = np.searchsorted(tks, qk, side="left")
+    right = np.searchsorted(tks, qk, side="right")
+    cnt = np.minimum(right - left, MAX_HITS_PER_KMER)
+    cnt[qk < 0] = 0
+    total = int(cnt.sum())
+    if total == 0:
+        return None
+    qpos = np.repeat(np.arange(len(qk)), cnt)
+    starts = np.repeat(left, cnt)
+    # within-run offsets 0..cnt-1
+    run_ids = np.repeat(np.cumsum(cnt) - cnt, cnt)
+    offs = np.arange(total) - run_ids
+    tpos = order[starts + offs]
+    diags = qpos - tpos
+
+    lo = -len(t)
+    nbins = (len(q) + len(t)) // DIAG_BIN + 2
+    binned = (diags - lo) // DIAG_BIN
+    hist = np.bincount(binned, minlength=nbins)
+    # sum adjacent bins so a diagonal straddling a boundary still wins
+    paired = hist[:-1] + hist[1:]
+    best = int(np.argmax(paired))
+    votes = int(paired[best])
+    if votes < min_votes:
+        return None
+    in_best = (binned == best) | (binned == best + 1)
+    diag = int(np.median(diags[in_best]))
+
+    Q, T = len(q), len(t)
+    i0 = max(diag, 0)
+    j0 = i0 - diag
+    i1 = min(Q, T + diag)
+    j1 = i1 - diag
+    line = np.array([i0, j0, i1, j1], dtype=np.int32)
+    return SeedHit(diag=diag, votes=votes, line=line)

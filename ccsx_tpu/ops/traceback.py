@@ -1,0 +1,129 @@
+"""Device traceback: move matrix -> star-MSA projection.
+
+Converts the packed move bytes emitted by ``banded_align(mode='global',
+with_moves=True)`` into the template-anchored projection used by the
+consensus vote (the same representation oracle.project_to_template builds):
+
+  aligned[j]   query code aligned to template column j (0-3), 4 = deletion
+  ins_cnt[j]   number of query bases inserted after template column j
+  ins_b[j, r]  the last ``max_ins`` inserted bases after column j, in
+               forward order, left-justified (PAD=5 elsewhere)
+  lead_ins     query bases consumed before template column 0 (counted for
+               cursor bookkeeping; not voted)
+
+The walk is a ``lax.while_loop`` from (qlen, tlen) back to (0, 0); batched
+with vmap it advances all alignments in lockstep, so each step is a batched
+gather from the move matrices (HBM) plus masked scatters into the
+projection arrays.  This replaces the role of bsalign's MSA materialization
+(tidy_msa_bspoa, main.c:572) — our "MSA" is the stack of these projections.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ccsx_tpu.ops.banded import EBIT_EXT, FBIT_EXT, MOVE_UP
+
+GAP = 4
+PAD = 5
+
+_H, _E, _F = 0, 1, 2
+
+
+def make_projector(tmax: int, max_ins: int = 4):
+    """Build a jitted projector for templates padded to ``tmax`` columns."""
+
+    @jax.jit
+    def project(moves, offs, q, qlen, tlen):
+        qmax = q.shape[0]
+        B = moves.shape[1]
+        aligned = jnp.full((tmax,), PAD, jnp.uint8)
+        # slot s+1 holds insertions after template column s; slot 0 holds
+        # the leading insertions (query bases before template column 0),
+        # which cursor bookkeeping must still count (main.c:622-638 walks
+        # every MSA cell)
+        ins_cnt = jnp.zeros((tmax + 1,), jnp.int32)
+        ins_b = jnp.full((tmax + 1, max_ins), PAD, jnp.uint8)
+
+        def cond(st):
+            i, j, state, *_ = st
+            return (i > 0) | (j > 0)
+
+        def body(st):
+            i, j, state, aligned, ins_cnt, ins_b = st
+            # move byte of cell (i, j); rows are 1-indexed: row i at moves[i-1]
+            row = jnp.clip(i - 1, 0, qmax - 1)
+            lane = jnp.clip(j - offs[row], 0, B - 1)
+            m = moves[row, lane].astype(jnp.int32)
+            choice = m & 3
+
+            def do_diag(st):
+                i, j, state, aligned, ins_cnt, ins_b = st
+                aligned = aligned.at[j - 1].set(q[i - 1])
+                return (i - 1, j - 1, jnp.int32(_H), aligned, ins_cnt, ins_b)
+
+            def do_up(st):
+                # consume one query base as an insertion after column j-1
+                # (slot j in the shifted ins arrays; j == 0 -> leading slot)
+                i, j, state, aligned, ins_cnt, ins_b = st
+                slot = j
+                cnt = ins_cnt[slot]
+                pos = max_ins - 1 - cnt
+                ins_b = jax.lax.cond(
+                    pos >= 0,
+                    lambda b: b.at[slot, jnp.maximum(pos, 0)].set(q[i - 1]),
+                    lambda b: b,
+                    ins_b,
+                )
+                ins_cnt = ins_cnt.at[slot].add(1)
+                nxt = jnp.where((m & EBIT_EXT) != 0, _E, _H)
+                # boundary: column 0 of the DP is a forced vertical run
+                nxt = jnp.where(j == 0, _E, nxt).astype(jnp.int32)
+                return (i - 1, j, nxt, aligned, ins_cnt, ins_b)
+
+            def do_left(st):
+                i, j, state, aligned, ins_cnt, ins_b = st
+                aligned = aligned.at[j - 1].set(GAP)
+                nxt = jnp.where((m & FBIT_EXT) != 0, _F, _H)
+                nxt = jnp.where(i == 0, _F, nxt).astype(jnp.int32)
+                return (i, j - 1, nxt, aligned, ins_cnt, ins_b)
+
+            # boundary overrides: off the matrix edges the op is forced
+            forced_up = (j == 0) & (i > 0)
+            forced_left = (i == 0) & (j > 0)
+            op = jnp.where(
+                forced_up, 1,
+                jnp.where(
+                    forced_left, 2,
+                    jnp.where(
+                        state == _E, 1,
+                        jnp.where(
+                            state == _F, 2,
+                            jnp.where(choice == 0, 0,
+                                      jnp.where(choice == MOVE_UP, 1, 2)),
+                        ),
+                    ),
+                ),
+            )
+            return jax.lax.switch(op, [do_diag, do_up, do_left], st)
+
+        i0 = qlen.astype(jnp.int32)
+        j0 = tlen.astype(jnp.int32)
+        st = (i0, j0, jnp.int32(_H), aligned, ins_cnt, ins_b)
+        _, _, _, aligned, ins_cnt, ins_b = jax.lax.while_loop(cond, body, st)
+
+        # left-justify the right-aligned insertion cells
+        used = jnp.minimum(ins_cnt, max_ins)
+        shift = (max_ins - used)[:, None]
+        cols = jnp.arange(max_ins)[None, :] + shift
+        ins_b = jnp.take_along_axis(
+            ins_b, jnp.clip(cols, 0, max_ins - 1), axis=1
+        )
+        ins_b = jnp.where(jnp.arange(max_ins)[None, :] < used[:, None],
+                          ins_b, PAD)
+        # split the leading slot back out: index j = insertions after
+        # template column j; lead_ins = query bases before column 0
+        return aligned, ins_cnt[1:], ins_b[1:], ins_cnt[0]
+
+    return project

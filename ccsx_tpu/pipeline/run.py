@@ -1,0 +1,110 @@
+"""End-to-end driver: input stream -> consensus -> ordered FASTA output.
+
+The reference overlaps read/compute/write with a 3-step ordered pipeline
+(kt_pipeline, main.c:856) and fans compute out over threads (kt_for,
+main.c:702-704).  Here: a bounded thread pool computes holes concurrently
+while the writer drains futures strictly in submission order, so output is
+`>movie/hole/ccs` in input order (main.c:714) for any thread count.
+"""
+
+from __future__ import annotations
+
+import collections
+import sys
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+from ccsx_tpu.config import CcsConfig
+from ccsx_tpu.consensus.align_host import HostAligner
+from ccsx_tpu.consensus.whole_read import ccs_whole_read
+from ccsx_tpu.consensus.windowed import ccs_windowed
+from ccsx_tpu.io import bam as bam_mod
+from ccsx_tpu.io import fastx, zmw
+from ccsx_tpu.utils.device import resolve_device
+from ccsx_tpu.utils.journal import Journal
+from ccsx_tpu.utils.metrics import Metrics
+
+
+def open_input(path: str, cfg: CcsConfig):
+    """Record iterator for BAM or FASTA/Q input ('-' = stdin).
+
+    Opens the file eagerly — the parsers are generators, and a deferred
+    open() would crash past the caller's error handling.
+    """
+    f = sys.stdin.buffer if path == "-" else open(path, "rb")
+    if cfg.is_bam:
+        return bam_mod.read_bam_records(f)
+    return fastx.read_fastx(f)
+
+
+def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
+                 journal_path: Optional[str] = None) -> int:
+    try:
+        records = open_input(in_path, cfg)
+    except OSError as e:
+        print(f"Error: Failed to open infile! ({e})", file=sys.stderr)
+        return 1
+    journal = Journal.load_or_create(journal_path, input_id=in_path)
+    resume = journal.holes_done
+    mode = "a" if resume else "w"
+    try:
+        out = sys.stdout if out_path == "-" else open(out_path, mode)
+    except OSError:
+        print("Cannot open file for write!", file=sys.stderr)
+        return 1
+
+    resolve_device(cfg.device)
+    aligner = HostAligner(cfg.align)
+    metrics = Metrics(verbose=cfg.verbose)
+    ccs_fn = ccs_windowed if cfg.split_subread else ccs_whole_read
+
+    def compute(z):
+        try:
+            return z, ccs_fn(z, aligner, cfg), None
+        except Exception as e:  # quarantine: one bad hole must not kill the run
+            return z, None, e
+
+    def write_result(item):
+        z, cns, err = item
+        if err is not None:
+            metrics.holes_failed += 1
+            print(f"[ccsx-tpu] hole {z.movie}/{z.hole} failed: {err}",
+                  file=sys.stderr)
+        elif cns:
+            out.write(f">{z.movie}/{z.hole}/ccs\n{cns.decode()}\n")
+            metrics.holes_out += 1
+        journal.advance()
+
+    rc = 0
+    pool = ThreadPoolExecutor(max_workers=max(cfg.threads, 1)) \
+        if cfg.threads > 1 else None
+    pending = collections.deque()
+    try:
+        stream = zmw.stream_zmws(records, cfg)
+        while True:
+            try:
+                z = next(stream)
+            except StopIteration:
+                break
+            metrics.holes_in += 1
+            if metrics.holes_in <= resume:
+                continue  # already written in a previous run
+            if pool is None:
+                write_result(compute(z))
+            else:
+                pending.append(pool.submit(compute, z))
+                # bounded window keeps memory flat; drain in order
+                while len(pending) > 2 * cfg.threads:
+                    write_result(pending.popleft().result())
+        while pending:
+            write_result(pending.popleft().result())
+    except (bam_mod.BamError, zmw.InvalidZmwName, ValueError) as e:
+        print(f"Error: invalid input stream: {e}", file=sys.stderr)
+        rc = 1
+    finally:
+        if pool is not None:
+            pool.shutdown(wait=True)
+        if out is not sys.stdout:
+            out.close()
+        metrics.report()
+    return rc

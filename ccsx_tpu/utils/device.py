@@ -1,0 +1,35 @@
+"""Backend selection.
+
+The runtime environment may register a TPU plugin that is not always
+reachable (tunnelled).  Resolve the backend once, up front, with a clean
+CPU fallback — a backend-init failure must abort clearly (or fall back),
+not surface as a per-hole error storm in the quarantine path.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def resolve_device(requested: str = "auto") -> str:
+    """Initialize JAX's backend per the request; returns the backend name.
+
+    requested: 'auto' (prefer the default, fall back to CPU),
+               'tpu' (require an accelerator), 'cpu' (force CPU).
+    """
+    import jax
+
+    if requested == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        return jax.default_backend()
+    try:
+        backend = jax.default_backend()
+        jax.devices()
+        return backend
+    except RuntimeError as e:
+        if requested == "tpu":
+            raise
+        print(f"[ccsx-tpu] accelerator unavailable ({e}); using CPU",
+              file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+        return jax.default_backend()

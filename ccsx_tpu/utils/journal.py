@@ -1,0 +1,43 @@
+"""Resume journal for long runs.
+
+The reference has no checkpointing (SURVEY.md §5.4): a crash means a full
+rerun.  Because output is strictly input-ordered, resumability only needs
+one cursor: how many filtered holes have been fully written.  On resume the
+pipeline skips that many holes and appends to the output.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+
+@dataclasses.dataclass
+class Journal:
+    path: str
+    input_id: str
+    holes_done: int = 0
+
+    @classmethod
+    def load_or_create(cls, path: Optional[str], input_id: str) -> "Journal":
+        j = cls(path=path or "", input_id=input_id)
+        if path and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    d = json.load(f)
+                if d.get("input_id") == input_id:
+                    j.holes_done = int(d.get("holes_done", 0))
+            except (OSError, ValueError):
+                pass  # unreadable journal: start over
+        return j
+
+    def advance(self, n: int = 1) -> None:
+        self.holes_done += n
+        if self.path:
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"input_id": self.input_id,
+                           "holes_done": self.holes_done}, f)
+            os.replace(tmp, self.path)

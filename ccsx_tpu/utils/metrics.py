@@ -1,0 +1,56 @@
+"""Counters and structured logging.
+
+The reference has no observability beyond -v stderr prints (SURVEY.md §5.5);
+this is the framework's replacement: cheap counters, a ZMWs/sec rate (the
+north-star metric, BASELINE.md), and optional JSON-lines emission.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import time
+from typing import Optional, TextIO
+
+
+@dataclasses.dataclass
+class Metrics:
+    verbose: int = 0
+    stream: Optional[TextIO] = None
+    holes_in: int = 0
+    holes_out: int = 0
+    holes_failed: int = 0
+    windows: int = 0
+    device_dispatches: int = 0
+    t0: float = dataclasses.field(default_factory=time.monotonic)
+
+    @property
+    def elapsed(self) -> float:
+        return max(time.monotonic() - self.t0, 1e-9)
+
+    @property
+    def zmws_per_sec(self) -> float:
+        return self.holes_out / self.elapsed
+
+    def snapshot(self) -> dict:
+        return {
+            "holes_in": self.holes_in,
+            "holes_out": self.holes_out,
+            "holes_failed": self.holes_failed,
+            "windows": self.windows,
+            "device_dispatches": self.device_dispatches,
+            "elapsed_s": round(self.elapsed, 3),
+            "zmws_per_sec": round(self.zmws_per_sec, 3),
+        }
+
+    def emit(self, event: str, **kw) -> None:
+        if self.stream is not None:
+            rec = {"event": event, **self.snapshot(), **kw}
+            self.stream.write(json.dumps(rec) + "\n")
+            self.stream.flush()
+
+    def report(self) -> None:
+        if self.verbose:
+            print(f"[ccsx-tpu] {json.dumps(self.snapshot())}", file=sys.stderr)
+        self.emit("final")

@@ -98,3 +98,12 @@ def identity(a: np.ndarray, b: np.ndarray) -> float:
 
     rs = oracle.align(a, b, mode="global")
     return rs.identity
+
+
+def identity_either(a: np.ndarray, b: np.ndarray) -> float:
+    """Identity of a vs b in the better of the two orientations.
+
+    Consensus strand follows the chosen template pass (an arbitrary strand,
+    in the reference as here), so template comparisons must accept either.
+    """
+    return max(identity(a, b), identity(enc.revcomp_codes(a), b))

@@ -1,0 +1,135 @@
+"""Tests for prepare (grouping/orientation) and whole-read star consensus."""
+
+import numpy as np
+import pytest
+
+from ccsx_tpu.config import CcsConfig
+from ccsx_tpu.consensus import prepare as prep
+from ccsx_tpu.consensus import whole_read
+from ccsx_tpu.consensus.align_host import HostAligner
+from ccsx_tpu.io import zmw as zmw_mod
+from ccsx_tpu.ops import encode as enc
+from ccsx_tpu.utils import synth
+
+CFG = CcsConfig(is_bam=False, len_bucket_quant=512)
+
+
+# ---------- length grouping ----------
+
+def test_group_lens_basic():
+    lens = [1000, 1010, 990, 5000, 1005, 4990]
+    groups = prep.group_lens(lens, 10)
+    assert groups[0].size == 4           # the ~1000 cluster is biggest
+    assert sorted(groups[0].ids) == [0, 1, 2, 4]
+    assert sorted(groups[1].ids) == [3, 5]
+
+
+def test_group_lens_transitive_merge():
+    # 110 cannot join {100} directly (|110-100|*100 == 1000 is not < 1000),
+    # so it forms its own group; once 105 joins {100} the means are within
+    # tolerance and the merge phase unifies them (main.c:169-195)
+    lens = [100, 110, 105]
+    groups = prep.group_lens(lens, 10)
+    assert len(groups) == 1
+    assert groups[0].size == 3
+
+
+def test_group_lens_singletons():
+    lens = [100, 500, 2500]
+    groups = prep.group_lens(lens, 10)
+    assert len(groups) == 3
+    assert all(g.size == 1 for g in groups)
+
+
+def test_len_in_group_integer_rule():
+    g = prep.LenGroup([0, 1], 2000)       # mean 1000
+    assert prep.len_in_group(g, 1049, 10)   # |1049*2-2000|=98 < 0.1*2000=200
+    assert not prep.len_in_group(g, 1100, 10)
+    assert not prep.len_in_group(g, 900, 10)
+
+
+# ---------- prepare / orientation walk ----------
+
+def _zmw_from_synth(z):
+    seqs = b"".join(enc.decode(p).encode() for p in z.passes)
+    lens = np.array([len(p) for p in z.passes], np.int32)
+    offs = np.zeros(len(lens), np.int32)
+    np.cumsum(lens[:-1], out=offs[1:])
+    return zmw_mod.Zmw(z.movie, z.hole, seqs, lens, offs)
+
+
+@pytest.mark.parametrize("n_passes,first_strand", [(5, 0), (6, 1)])
+def test_prepare_orientation_parity(n_passes, first_strand, rng):
+    z = synth.make_zmw(rng, template_len=1000, n_passes=n_passes,
+                       first_strand=first_strand)
+    zz = _zmw_from_synth(z)
+    codes = enc.encode(zz.seqs)
+    aligner = HostAligner(CFG.align)
+    segs = prep.ccs_prepare(codes, zz.lens, zz.offs, aligner, CFG)
+    assert len(segs) == n_passes          # all passes kept
+    template_i = n_passes // 2            # ids in insertion order
+    # template first, not reversed (it defines the frame)
+    assert segs[0].offs == int(zz.offs[template_i])
+    assert not segs[0].reverse
+    # every segment's reverse flag must match ground truth relative strand
+    t_strand = z.strands[template_i]
+    seg_by_offs = {s.offs: s for s in segs}
+    for k in range(n_passes):
+        s = seg_by_offs[int(zz.offs[k])]
+        assert s.reverse == (z.strands[k] != t_strand), k
+
+
+def test_prepare_drops_unalignable_pass(rng):
+    z = synth.make_zmw(rng, template_len=1000, n_passes=5)
+    # replace last pass with random junk of in-group length
+    junk = rng.integers(0, 4, 1000).astype(np.uint8)
+    z.passes[-1] = junk
+    zz = _zmw_from_synth(z)
+    codes = enc.encode(zz.seqs)
+    aligner = HostAligner(CFG.align)
+    segs = prep.ccs_prepare(codes, zz.lens, zz.offs, aligner, CFG)
+    # junk is in the length group and parity-trusted *until* a mismatch event;
+    # at minimum the first 4 passes survive and junk is never *aligned* in
+    assert len(segs) >= 4
+
+
+def test_prepare_clips_double_length_pass(rng):
+    """A pass of ~2x template length (missed adapter) must be clipped to
+    one template span (main.c:392-394)."""
+    tpl = rng.integers(0, 4, 1000).astype(np.uint8)
+    z = synth.make_zmw(rng, n_passes=5, template=tpl)
+    # build a double-copy pass: template + revcomp(template) noisified
+    double = np.concatenate([
+        synth.mutate(rng, tpl, 0.02, 0.04, 0.04),
+        enc.revcomp_codes(synth.mutate(rng, tpl, 0.02, 0.04, 0.04)),
+    ])
+    z.passes.append(double)
+    z.strands.append(0)
+    zz = _zmw_from_synth(z)
+    codes = enc.encode(zz.seqs)
+    aligner = HostAligner(CFG.align)
+    segs = prep.ccs_prepare(codes, zz.lens, zz.offs, aligner, CFG)
+    clipped = [s for s in segs if s.offs >= int(zz.offs[5])]
+    if clipped:  # if kept, it must be clipped to ~template length
+        assert abs(clipped[0].length - 1000) < 150
+
+
+# ---------- whole-read consensus ----------
+
+@pytest.mark.parametrize("n_passes,min_identity", [(5, 0.98), (8, 0.992)])
+def test_whole_read_consensus_identity(n_passes, min_identity, rng):
+    z = synth.make_zmw(rng, template_len=800, n_passes=n_passes,
+                       sub_rate=0.02, ins_rate=0.04, del_rate=0.04)
+    zz = _zmw_from_synth(z)
+    aligner = HostAligner(CFG.align)
+    cns = whole_read.ccs_whole_read(zz, aligner, CFG)
+    assert cns is not None
+    idy = synth.identity(enc.encode(cns), z.template)
+    assert idy >= min_identity, f"consensus identity {idy:.4f}"
+
+
+def test_whole_read_too_few_passes(rng):
+    z = synth.make_zmw(rng, template_len=800, n_passes=2)
+    zz = _zmw_from_synth(z)
+    aligner = HostAligner(CFG.align)
+    assert whole_read.ccs_whole_read(zz, aligner, CFG) is None

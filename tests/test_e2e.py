@@ -1,0 +1,185 @@
+"""End-to-end: CLI flows over synthetic FASTA/BAM; windowed consensus."""
+
+import io
+
+import numpy as np
+import pytest
+
+from ccsx_tpu import cli
+from ccsx_tpu.config import CcsConfig
+from ccsx_tpu.consensus import windowed
+from ccsx_tpu.consensus.align_host import HostAligner
+from ccsx_tpu.io import bam, fastx, zmw as zmw_mod
+from ccsx_tpu.ops import encode as enc
+from ccsx_tpu.utils import synth
+
+
+def _zmw_from_synth(z):
+    seqs = b"".join(enc.decode(p).encode() for p in z.passes)
+    lens = np.array([len(p) for p in z.passes], np.int32)
+    offs = np.zeros(len(lens), np.int32)
+    np.cumsum(lens[:-1], out=offs[1:])
+    return zmw_mod.Zmw(z.movie, z.hole, seqs, lens, offs)
+
+
+# ---------- windowed consensus ----------
+
+def test_windowed_matches_template_long_read(rng):
+    """A >1-window molecule: the shred path must stitch windows correctly."""
+    cfg = CcsConfig(is_bam=False, window_init=1024, window_add=1024,
+                    window_minlen=512, max_window=4096)
+    z = synth.make_zmw(rng, template_len=3000, n_passes=6,
+                       sub_rate=0.02, ins_rate=0.04, del_rate=0.04)
+    zz = _zmw_from_synth(z)
+    cns = windowed.ccs_windowed(zz, HostAligner(cfg.align), cfg)
+    assert cns is not None
+    idy = synth.identity_either(enc.encode(cns), z.template)
+    assert idy > 0.985, f"windowed identity {idy:.4f}"
+    assert abs(len(cns) - 3000) < 60
+
+
+def test_windowed_short_molecule_single_flush(rng):
+    """Molecules shorter than a window take the final-flush path only."""
+    cfg = CcsConfig(is_bam=False)
+    z = synth.make_zmw(rng, template_len=700, n_passes=5)
+    zz = _zmw_from_synth(z)
+    cns = windowed.ccs_windowed(zz, HostAligner(cfg.align), cfg)
+    idy = synth.identity_either(enc.encode(cns), z.template)
+    assert idy > 0.97
+
+
+# ---------- BAM ----------
+
+def test_bam_roundtrip(tmp_path):
+    p = tmp_path / "t.bam"
+    recs = [("m0/1/0_8", b"ACGTACGT", b"IIIIIIII"),
+            ("m0/1/8_12", b"GGGG", b"!!!!"),
+            ("m0/2/0_4", b"TTTT", None)]
+    bam.write_bam(p, recs)
+    got = list(bam.read_bam_records(p))
+    assert [r.name for r in got] == ["m0/1/0_8", "m0/1/8_12", "m0/2/0_4"]
+    assert got[0].seq == b"ACGTACGT"
+    assert got[0].qual == b"IIIIIIII"
+    assert got[1].qual == b"!!!!"
+
+
+def test_bam_bad_magic(tmp_path):
+    p = tmp_path / "bad.bam"
+    p.write_bytes(b"NOTBAM..")
+    with pytest.raises(bam.BamError):
+        list(bam.read_bam_records(p))
+
+
+def test_bam_truncated(tmp_path):
+    import gzip as _gz
+    p = tmp_path / "t.bam"
+    bam.write_bam(p, [("m0/1/0_8", b"ACGTACGT", None)])
+    raw = _gz.decompress(p.read_bytes())
+    q = tmp_path / "trunc.bam"
+    q.write_bytes(_gz.compress(raw[:-5]))
+    with pytest.raises(bam.BamError):
+        list(bam.read_bam_records(q))
+
+
+# ---------- CLI ----------
+
+def _make_inputs(tmp_path, rng, n_holes=2):
+    zs = [synth.make_zmw(rng, template_len=900, n_passes=5, movie="mv",
+                         hole=str(100 + h)) for h in range(n_holes)]
+    fa = tmp_path / "in.fa"
+    fa.write_text(synth.make_fasta(zs))
+    return zs, fa
+
+
+def _parse_fasta(path):
+    recs = list(fastx.read_fastx(str(path)))
+    return {r.name: r.seq for r in recs}
+
+
+def test_cli_fasta_to_fasta(tmp_path, rng):
+    zs, fa = _make_inputs(tmp_path, rng)
+    out = tmp_path / "out.fa"
+    rc = cli.main(["-A", "-m", "1000", str(fa), str(out)])
+    assert rc == 0
+    got = _parse_fasta(out)
+    assert set(got) == {"mv/100/ccs", "mv/101/ccs"}
+    for z in zs:
+        cns = enc.encode(got[f"mv/{z.hole}/ccs"])
+        assert synth.identity_either(cns, z.template) > 0.97
+
+
+def test_cli_whole_read_mode(tmp_path, rng):
+    zs, fa = _make_inputs(tmp_path, rng, n_holes=1)
+    out = tmp_path / "out.fa"
+    rc = cli.main(["-A", "-P", "-m", "1000", str(fa), str(out)])
+    assert rc == 0
+    got = _parse_fasta(out)
+    assert set(got) == {"mv/100/ccs"}
+
+
+def test_cli_exclusion_and_filters(tmp_path, rng):
+    zs, fa = _make_inputs(tmp_path, rng)
+    out = tmp_path / "out.fa"
+    rc = cli.main(["-A", "-m", "1000", "-X", "100", str(fa), str(out)])
+    assert rc == 0
+    assert set(_parse_fasta(out)) == {"mv/101/ccs"}
+
+
+def test_cli_min_count_validation(capsys):
+    rc = cli.main(["-c", "2", "x", "y"])
+    assert rc == -1
+    assert "min fulllen count" in capsys.readouterr().err
+
+
+def test_cli_bam_input(tmp_path, rng):
+    z = synth.make_zmw(rng, template_len=900, n_passes=5, movie="mv",
+                       hole="7")
+    p = tmp_path / "in.bam"
+    recs = [(n, enc.decode(s).encode(), None)
+            for n, s in zip(z.names, z.passes)]
+    bam.write_bam(p, recs)
+    out = tmp_path / "out.fa"
+    rc = cli.main(["-m", "1000", str(p), str(out)])
+    assert rc == 0
+    got = _parse_fasta(out)
+    assert set(got) == {"mv/7/ccs"}
+    assert synth.identity_either(enc.encode(got["mv/7/ccs"]), z.template) > 0.97
+
+
+def test_cli_threaded_output_order_matches_serial(tmp_path, rng):
+    """-j N must preserve input-ordered output (kt_pipeline invariant)."""
+    zs, fa = _make_inputs(tmp_path, rng, n_holes=3)
+    out1 = tmp_path / "o1.fa"
+    out2 = tmp_path / "o2.fa"
+    assert cli.main(["-A", "-m", "1000", str(fa), str(out1)]) == 0
+    assert cli.main(["-A", "-m", "1000", "-j", "3", str(fa), str(out2)]) == 0
+    assert out1.read_text() == out2.read_text()
+
+
+def test_cli_journal_resume(tmp_path, rng):
+    """A resumed run skips already-written holes and appends the rest."""
+    zs, fa = _make_inputs(tmp_path, rng, n_holes=3)
+    full = tmp_path / "full.fa"
+    assert cli.main(["-A", "-m", "1000", str(fa), str(full)]) == 0
+
+    out = tmp_path / "o.fa"
+    jp = tmp_path / "j.json"
+    # simulate a crashed run that completed 2 holes
+    import json
+    jp.write_text(json.dumps({"input_id": str(fa), "holes_done": 2}))
+    recs = list(fastx.read_fastx(str(full)))
+    out.write_text("".join(f">{r.name}\n{r.seq.decode()}\n"
+                           for r in recs[:2]))
+    assert cli.main(["-A", "-m", "1000", "--journal", str(jp),
+                     str(fa), str(out)]) == 0
+    assert out.read_text() == full.read_text()
+    assert json.loads(jp.read_text())["holes_done"] == 3
+
+
+def test_cli_corrupt_bam_clean_error(tmp_path, capsys):
+    p = tmp_path / "bad.bam"
+    import gzip as _gz
+    p.write_bytes(_gz.compress(b"NOTBAM" + b"\x00" * 50))
+    rc = cli.main([str(p), str(tmp_path / "o.fa")])
+    assert rc == 1
+    assert "invalid input stream" in capsys.readouterr().err

@@ -1,0 +1,60 @@
+import numpy as np
+
+from ccsx_tpu.ops import encode as enc, seed
+from ccsx_tpu.utils import synth
+
+
+def test_kmer_codes_basic():
+    s = enc.encode("ACGTACGTACGTACGT")
+    k = seed.kmer_codes(s, 4)
+    assert len(k) == 13
+    assert k[0] == (0 << 6) | (1 << 4) | (2 << 2) | 3
+    assert k[0] == k[4]  # periodic sequence
+
+
+def test_kmer_codes_n_invalid():
+    s = enc.encode("ACGTNACGTACGT")
+    k = seed.kmer_codes(s, 4)
+    assert (k[1:5] == -1).all()  # windows covering the N
+    assert k[0] != -1 and k[5] != -1
+
+
+def test_seed_diagonal_identity(rng):
+    t = rng.integers(0, 4, 500).astype(np.uint8)
+    hit = seed.seed_diagonal(t, t)
+    assert hit is not None
+    assert abs(hit.diag) <= seed.DIAG_BIN
+
+
+def test_seed_diagonal_offset(rng):
+    t = rng.integers(0, 4, 400).astype(np.uint8)
+    q = np.concatenate([rng.integers(0, 4, 300).astype(np.uint8), t])
+    hit = seed.seed_diagonal(q, t)
+    assert hit is not None
+    assert abs(hit.diag - 300) <= seed.DIAG_BIN
+    # line endpoints lie on the diagonal
+    i0, j0, i1, j1 = hit.line
+    assert i0 - j0 == hit.diag and i1 - j1 == hit.diag
+
+
+def test_seed_diagonal_noisy(rng):
+    t = rng.integers(0, 4, 600).astype(np.uint8)
+    q = synth.mutate(rng, t, 0.03, 0.05, 0.05)
+    hit = seed.seed_diagonal(q, t)
+    assert hit is not None
+    assert abs(hit.diag) <= 2 * seed.DIAG_BIN
+
+
+def test_seed_diagonal_unrelated(rng):
+    q = rng.integers(0, 4, 300).astype(np.uint8)
+    t = rng.integers(0, 4, 300).astype(np.uint8)
+    hit = seed.seed_diagonal(q, t)
+    # random 300-mers share few 13-mers; votes must be tiny or absent
+    assert hit is None or hit.votes <= 5
+
+
+def test_seed_short_sequences():
+    assert seed.seed_diagonal(np.zeros(5, np.uint8), np.zeros(5, np.uint8)) is None or True
+    # shorter than k: no crash, returns None
+    out = seed.seed_diagonal(np.zeros(3, np.uint8), np.zeros(30, np.uint8))
+    assert out is None

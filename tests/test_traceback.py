@@ -1,0 +1,100 @@
+"""Differential tests: device traceback projection vs oracle projection."""
+
+import numpy as np
+import pytest
+
+from ccsx_tpu.config import AlignParams
+from ccsx_tpu.ops import banded, oracle, traceback
+from ccsx_tpu.utils import synth
+
+P = AlignParams()
+SCORES = dict(match=P.match, mismatch=P.mismatch,
+              gap_open=P.gap_open, gap_extend=P.gap_extend)
+QMAX = TMAX = 256
+MAXINS = 4
+
+
+def _pad(x, n):
+    out = np.full(n, banded.PAD, dtype=np.uint8)
+    out[: len(x)] = x
+    return out
+
+
+def project_device(q, t):
+    res, moves, offs = banded.banded_align(
+        _pad(q, QMAX), np.int32(len(q)), _pad(t, TMAX), np.int32(len(t)),
+        mode="global", with_moves=True,
+    )
+    proj = traceback.make_projector(TMAX, MAXINS)
+    aligned, ins_cnt, ins_b, lead = proj(moves, offs, _pad(q, QMAX),
+                                         np.int32(len(q)), np.int32(len(t)))
+    return (int(res.score), np.array(aligned), np.array(ins_cnt),
+            np.array(ins_b), int(lead))
+
+
+def project_oracle(q, t):
+    rs = oracle.align(q, t, mode="global", **SCORES)
+    aligned, ins_len, ins_bases, _ = oracle.project_to_template(
+        rs, q, len(t), MAXINS)
+    return rs.score, aligned, ins_len, ins_bases
+
+
+def check_consistency(q, t, aligned, ins_cnt, ins_b, lead=0):
+    """Structural invariants that hold for ANY valid global alignment."""
+    T = len(t)
+    # every template column consumed exactly once
+    assert (aligned[:T] != traceback.PAD).all()
+    assert (aligned[T:] == traceback.PAD).all()
+    # query bases conserved: matches/mismatches + insertions == len(q)
+    consumed = int((aligned[:T] < 4).sum() + ins_cnt[:T].sum() + lead)
+    assert consumed == len(q)
+    assert ins_cnt[T:].sum() == 0
+    # stored insertion cells agree with counts
+    used = np.minimum(ins_cnt[:T], MAXINS)
+    stored = (ins_b[:T] != traceback.PAD).sum(axis=1)
+    assert np.array_equal(stored, used)
+
+
+def test_identical_projection():
+    t = np.array([0, 1, 2, 3] * 10, dtype=np.uint8)
+    score, aligned, ins_cnt, ins_b, lead = project_device(t, t)
+    assert np.array_equal(aligned[: len(t)], t)
+    assert ins_cnt.sum() == 0
+
+
+@pytest.mark.parametrize("trial", range(6))
+def test_projection_matches_oracle(trial):
+    rng = np.random.default_rng(100 + trial)
+    t = rng.integers(0, 4, int(rng.integers(60, 200))).astype(np.uint8)
+    q = synth.mutate(rng, t, 0.03, 0.05, 0.05)
+    if len(q) > QMAX:
+        q = q[:QMAX]
+    d_score, d_al, d_cnt, d_b, d_lead = project_device(q, t)
+    o_score, o_al, o_cnt, o_b = project_oracle(q, t)
+    assert d_score == o_score
+    check_consistency(q, t, d_al, d_cnt, d_b, d_lead)
+    # projections may differ between co-optimal paths; they must agree on
+    # the vast majority of columns
+    T = len(t)
+    agree = (d_al[:T] == o_al).mean()
+    assert agree > 0.9, agree
+
+
+def test_insertion_content():
+    rng = np.random.default_rng(7)
+    t = rng.integers(0, 4, 100).astype(np.uint8)
+    # insert a known 2-base motif after column 50
+    q = np.concatenate([t[:50], np.array([2, 2], np.uint8), t[50:]])
+    _, aligned, ins_cnt, ins_b, _lead = project_device(q, t)
+    assert ins_cnt[:100].sum() == 2
+    slot = int(np.nonzero(ins_cnt[:100])[0][0])
+    n = int(ins_cnt[slot])
+    assert (ins_b[slot, :n] == 2).all()
+
+
+def test_deletion_marked():
+    rng = np.random.default_rng(8)
+    t = rng.integers(0, 4, 100).astype(np.uint8)
+    q = np.delete(t, 60)
+    _, aligned, ins_cnt, ins_b, _lead = project_device(q, t)
+    assert (aligned[:100] == 4).sum() == 1
