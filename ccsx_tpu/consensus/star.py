@@ -13,13 +13,14 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import List, Sequence
 
 import jax
 import numpy as np
 
 from ccsx_tpu.config import AlignParams
-from ccsx_tpu.ops import banded, msa, traceback
+from ccsx_tpu.ops import banded, banded_pallas, msa, traceback
 
 
 def pass_bucket(n: int, buckets: Sequence[int]) -> int:
@@ -39,12 +40,40 @@ def pad_to(x: np.ndarray, n: int) -> np.ndarray:
     return out
 
 
+def use_pallas() -> bool:
+    """Pallas banded kernel on TPU by default; CCSX_BANDED_IMPL overrides
+    ({pallas, scan}).  The scan implementation is the spec — the kernel is
+    differential-tested bit-exact against it (tests/test_banded_pallas.py)."""
+    impl = os.environ.get("CCSX_BANDED_IMPL", "")
+    if impl not in ("", "pallas", "scan"):
+        raise ValueError(
+            f"CCSX_BANDED_IMPL={impl!r}: expected 'pallas' or 'scan'")
+    if impl == "pallas":
+        return True
+    if impl == "scan":
+        return False
+    return jax.default_backend() == "tpu"
+
+
 @functools.lru_cache(maxsize=8)
 def _aligner(params: AlignParams):
     # one jitted aligner per scoring config; shape specialization is
     # handled by jit's own trace cache, so distinct (qmax, tmax) buckets
-    # reuse this callable instead of rebuilding it
-    return banded.make_batched("global", params, with_moves=True)
+    # reuse this callable instead of rebuilding it.  The impl choice is
+    # re-evaluated per call so CCSX_BANDED_IMPL works after first use.
+    scan_f = banded.make_batched("global", params, with_moves=True)
+
+    def f(qs, qlens, ts, tlens):
+        qmax = qs.shape[-1]
+        if (not use_pallas()
+                or qmax > banded_pallas.PALLAS_MAX_QMAX
+                or qmax % banded_pallas.ROWBLOCK != 0):
+            return scan_f(qs, qlens, ts, tlens)
+        return banded_pallas.batched_align_global_moves(
+            qs, qlens, ts, tlens, params,
+            interpret=jax.default_backend() != "tpu")
+
+    return f
 
 
 @functools.lru_cache(maxsize=64)

@@ -1,0 +1,363 @@
+"""Pallas TPU kernel for the banded affine-gap DP fill (global+moves mode).
+
+This is the hot op of the framework: every consensus round aligns each pass
+window against the draft (star.round), which the reference does inside
+bsalign's banded-striped SIMD POA (end_bspoa, main.c:492; band=128 at
+main.c:849).  The semantics here are *identical* to the lax.scan
+implementation in ops/banded.py (mode='global', with_moves=True) — that
+version remains the spec and the differential-test oracle; this one maps the
+fill onto a single Pallas kernel so the whole DP runs out of VMEM with no
+per-row HLO overhead.
+
+Design notes (why the kernel looks like this):
+
+* The band-offset schedule ``offs`` is data-INdependent — it is a pure
+  function of (qlen, tlen, line) — so it is computed outside the kernel
+  with a tiny vectorized ``lax.scan`` (compute_offsets) and fed to the
+  kernel through SMEM.  The traceback needs the same array, so nothing is
+  wasted.
+* The only per-cell input the recurrence needs from (q, t) is the match
+  indicator; ``ismatch[i-1, k] = q[i-1] == t[offs[i]+k-1]`` is precomputed
+  as a (Qmax, B) int8 gather outside the kernel.  Inside, each row is a
+  dynamic *sublane* read — cheap — whereas gathering t by a dynamic lane
+  offset in-kernel would be a lane-rotate per row.
+* The previous-row band must be shifted by d = offs[i] - offs[i-1] ∈
+  [0, maxshift].  d is tiny, so the kernel computes all maxshift+2 static
+  lane shifts of the carry block and picks with a select chain — static
+  shifts vectorize on the VPU; a dynamic lane shift would not.
+* The horizontal (within-row) affine gap F is an associative max-plus
+  prefix scan (see ops/banded.py); here it is a log2(B)-step Hillis-Steele
+  scan of static lane shifts.
+* Outputs: the packed move byte per cell (uint8, written row-by-row into
+  the VMEM output block) and the final H/mat/aln bands; score extraction
+  happens outside.
+
+The kernel is gated to Qmax <= PALLAS_MAX_QMAX (VMEM/SMEM budget); the
+windowed consensus path (the default) always fits.  Callers use
+ops/banded.select_aligner-style dispatch in consensus/star.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ccsx_tpu.config import AlignParams
+from ccsx_tpu.ops.banded import (
+    BandedResult, EBIT_EXT, FBIT_EXT, MOVE_DIAG, MOVE_LEFT, MOVE_UP, NEG, PAD,
+)
+
+# rows of the carry block: H, E, mat, aln, Emat, Ealn
+_CH = 6
+_ROW_H, _ROW_E, _ROW_MAT, _ROW_ALN, _ROW_EMAT, _ROW_EALN = range(_CH)
+
+PALLAS_MAX_QMAX = 4096  # beyond this fall back to the scan implementation
+
+
+def compute_offsets(qlen, tlen, qmax: int, band: int, maxshift: int,
+                    line=None):
+    """The band-offset schedule for rows 1..qmax (shape (qmax,) int32).
+
+    Bit-exact replica of the offset recurrence in ops/banded.py's scan body
+    (global mode), including the freeze beyond qlen.  Vectorize over a batch
+    with jax.vmap.
+    """
+    qlen = qlen.astype(jnp.int32)
+    tlen = tlen.astype(jnp.int32)
+    tcap = jnp.maximum(tlen - band + 1, 0)
+    if line is None:
+        li0, lj0, li1, lj1 = (jnp.int32(0), jnp.int32(0), qlen, tlen)
+    else:
+        line = jnp.asarray(line, jnp.int32)
+        li0, lj0, li1, lj1 = line[0], line[1], line[2], line[3]
+
+    def body(off_prev, i):
+        nom_j = lj0 + ((i - li0) * (lj1 - lj0)) // jnp.maximum(li1 - li0, 1)
+        desired = nom_j - band // 2
+        lo = jnp.maximum(0, tcap - (qlen - i) * maxshift)
+        off = jnp.clip(
+            jnp.maximum(desired, lo), off_prev,
+            jnp.minimum(off_prev + maxshift, tcap),
+        )
+        off = jnp.maximum(off, off_prev)
+        off = jnp.where(i <= qlen, off, off_prev)
+        return off, off
+
+    _, offs = jax.lax.scan(
+        body, jnp.int32(0), jnp.arange(1, qmax + 1, dtype=jnp.int32))
+    return offs
+
+
+def compute_ismatch(q, t, offs, band: int, maxshift: int):
+    """(Qmax, band) int8 match indicators: row i-1 lane k compares q[i-1]
+    with the base entering column offs[i]+k (PAD-safe)."""
+    qmax = q.shape[0]
+    tpad = jnp.concatenate([
+        jnp.full((1,), PAD, jnp.uint8), t.astype(jnp.uint8),
+        jnp.full((band + maxshift,), PAD, jnp.uint8),
+    ])
+    j = offs[:, None] + jnp.arange(band, dtype=jnp.int32)[None, :]
+    tb = tpad[j]
+    qi = q[:, None]
+    ismatch = (qi == tb) & (qi < 4) & (tb < 4)
+    return ismatch.astype(jnp.int8)
+
+
+ROWBLOCK = 8  # rows per grid step: aligned sublane tiles for loads/stores
+
+
+def _kernel(offs_ref, qlen_ref, tlen_ref, ismatch_ref, moves_ref, fin_ref,
+            ch_ref, *, qmax: int, band: int, maxshift: int,
+            params: AlignParams):
+    M, X = params.match, params.mismatch
+    O, E = params.gap_open, params.gap_extend
+    B = band
+    r = pl.program_id(1)
+    qlen = qlen_ref[0, 0, 0]
+    tlen = tlen_ref[0, 0, 0]
+    karr = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
+    negf = jnp.full((_CH, 1), NEG, jnp.int32)
+
+    def shift_ch(ch, s):
+        """Static lane shift: out[:, k] = ch[:, k+s], NEG fill (matches
+        _pad_prev in ops/banded.py, which pads NEG on both sides)."""
+        if s == 0:
+            return ch
+        if s > 0:
+            return jnp.concatenate(
+                [ch[:, s:], jnp.broadcast_to(negf, (_CH, s))], axis=1)
+        return jnp.concatenate(
+            [jnp.broadcast_to(negf, (_CH, -s)), ch[:, :s]], axis=1)
+
+    def shift_row(x, s, fill):
+        if s == 0:
+            return x
+        f = jnp.full((x.shape[0], abs(s)), fill, x.dtype)
+        if s > 0:
+            return jnp.concatenate([x[:, s:], f], axis=1)
+        return jnp.concatenate([f, x[:, :s]], axis=1)
+
+    # ---- row 0 init (off = 0), exactly ops/banded.py carry0 ----
+    @pl.when(r == 0)
+    def _():
+        j0 = karr
+        H0 = jnp.where(j0 <= tlen, jnp.where(j0 == 0, 0, O + E * j0), NEG)
+        E0 = jnp.full((1, B), NEG, jnp.int32)
+        mat0 = jnp.zeros((1, B), jnp.int32)
+        aln0 = j0
+        ch_ref[:] = jnp.concatenate([H0, E0, mat0, aln0, mat0, aln0], axis=0)
+
+    # int32 throughout: sublane slices of i1 vectors hit Mosaic relayout
+    # limits, so the match indicator stays arithmetic (0/1)
+    ismatch_tile = ismatch_ref[0].astype(jnp.int32)  # (ROWBLOCK, B)
+    ch = ch_ref[:]
+    moves_rows = []
+    for s in range(ROWBLOCK):
+        i = r * ROWBLOCK + s + 1
+        off = offs_ref[0, 0, i - 1]
+        off_prev = jnp.where(i == 1, 0, offs_ref[0, 0, jnp.maximum(i - 2, 0)])
+        d = off - off_prev
+
+        # select the d-shifted views of the carry (diag wants shift d-1)
+        s_diag = shift_ch(ch, -1)
+        s_up = shift_ch(ch, 0)
+        for dd in range(1, maxshift + 1):
+            s_diag = jnp.where(d == dd, shift_ch(ch, dd - 1), s_diag)
+            s_up = jnp.where(d == dd, shift_ch(ch, dd), s_up)
+
+        Hd_diag = s_diag[_ROW_H:_ROW_H + 1]
+        mat_diag = s_diag[_ROW_MAT:_ROW_MAT + 1]
+        aln_diag = s_diag[_ROW_ALN:_ROW_ALN + 1]
+        H_up = s_up[_ROW_H:_ROW_H + 1]
+        E_up = s_up[_ROW_E:_ROW_E + 1]
+        mat_up = s_up[_ROW_MAT:_ROW_MAT + 1]
+        aln_up = s_up[_ROW_ALN:_ROW_ALN + 1]
+        Emat_up = s_up[_ROW_EMAT:_ROW_EMAT + 1]
+        Ealn_up = s_up[_ROW_EALN:_ROW_EALN + 1]
+
+        im = ismatch_tile[s:s + 1, :]  # (1, B) int32 0/1
+        sub = X + (M - X) * im
+        j = off + karr
+
+        # E (vertical)
+        e_ext = E_up + E
+        e_open = H_up + O + E
+        e_is_open = e_open >= e_ext
+        Enew = jnp.maximum(e_ext, e_open)
+        Emat = jnp.where(e_is_open, mat_up, Emat_up)
+        Ealn = jnp.where(e_is_open, aln_up, Ealn_up) + 1
+
+        # Hd = best of diag / E
+        diag_term = Hd_diag + sub
+        d_wins = diag_term >= Enew
+        Hd = jnp.maximum(diag_term, Enew)
+        Hmat = jnp.where(d_wins, mat_diag + im, Emat)
+        Haln = jnp.where(d_wins, aln_diag, Ealn - 1) + 1
+
+        # boundary lane j == 0 (global mode)
+        at0 = j == 0
+        b_H = O + E * i
+        Hd = jnp.where(at0, b_H, Hd)
+        Enew = jnp.where(at0, b_H, Enew)
+        Hmat = jnp.where(at0, 0, Hmat)
+        Haln = jnp.where(at0, i, Haln)
+        Emat = jnp.where(at0, 0, Emat)
+        Ealn = jnp.where(at0, i, Ealn)
+
+        # invalid lanes beyond the template
+        invalid = j > tlen
+        Hd = jnp.where(invalid, NEG, Hd)
+        Enew = jnp.where(invalid, NEG, Enew)
+
+        # F (horizontal) max-plus prefix scan, Hillis-Steele over lanes.
+        # combine(left, right) keeps right on ties (ops/banded.py
+        # _combine_rightmax); shifted-in identity = NEG score.
+        v = Hd + O - E * karr
+        fm = Hmat
+        fa = Haln - karr
+        step = 1
+        while step < B:
+            vs = shift_row(v, -step, NEG)
+            ms = shift_row(fm, -step, NEG)
+            as_ = shift_row(fa, -step, NEG)
+            keep = v >= vs
+            v = jnp.where(keep, v, vs)
+            fm = jnp.where(keep, fm, ms)
+            fa = jnp.where(keep, fa, as_)
+            step *= 2
+        # exclusive: shift right by one (score fill NEG, stats fill 0)
+        v = shift_row(v, -1, NEG)
+        fm = shift_row(fm, -1, 0)
+        fa = shift_row(fa, -1, 0)
+        F = v + E * karr
+        Fmat = fm
+        Faln = fa + karr
+
+        hd_wins = Hd >= F
+        Hnew = jnp.maximum(Hd, F)
+        mat_new = jnp.where(hd_wins, Hmat, Fmat)
+        aln_new = jnp.where(hd_wins, Haln, Faln)
+
+        # moves byte
+        choice = jnp.where(
+            hd_wins & d_wins, MOVE_DIAG,
+            jnp.where(hd_wins, MOVE_UP, MOVE_LEFT)).astype(jnp.uint8)
+        ebit = jnp.where(e_is_open, 0, EBIT_EXT).astype(jnp.uint8)
+        H_left = shift_row(Hnew, -1, NEG)
+        f_is_open = F == (H_left + O + E)
+        fbit = jnp.where(f_is_open, 0, FBIT_EXT).astype(jnp.uint8)
+        moves_rows.append(choice | ebit | fbit)
+
+        ch_new = jnp.concatenate(
+            [Hnew, Enew, mat_new, aln_new, Emat, Ealn], axis=0)
+        live = i <= qlen
+        ch = jnp.where(live, ch_new, ch)
+
+    moves_ref[0] = jnp.concatenate(moves_rows, axis=0)
+    ch_ref[:] = ch
+
+    @pl.when(r == pl.num_programs(1) - 1)
+    def _():
+        fin_ref[0, 0:1, :] = ch[_ROW_H:_ROW_H + 1]
+        fin_ref[0, 1:2, :] = ch[_ROW_MAT:_ROW_MAT + 1]
+        fin_ref[0, 2:3, :] = ch[_ROW_ALN:_ROW_ALN + 1]
+        fin_ref[0, 3:8, :] = jnp.zeros((5, band), jnp.int32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("params", "band", "maxshift", "interpret"))
+def batched_align_global_moves(
+    qs: jnp.ndarray,
+    qlens: jnp.ndarray,
+    ts: jnp.ndarray,
+    tlens: jnp.ndarray,
+    params: AlignParams = AlignParams(),
+    band: int | None = None,
+    maxshift: int = 4,
+    interpret: bool = False,
+):
+    """Batched global banded alignment with move emission (Pallas).
+
+    Drop-in for the vmapped scan aligner used by the consensus rounds
+    (consensus/star.py): same argument shapes — (..., Qmax) uint8 queries,
+    (...,) lengths, (..., Tmax) uint8 templates — and the same
+    (BandedResult, moves, offs) result tuple.
+    """
+    B = band if band is not None else params.band
+    lead = qs.shape[:-1]
+    qmax = qs.shape[-1]
+    if qmax > PALLAS_MAX_QMAX:
+        raise ValueError(
+            f"qmax={qmax} exceeds PALLAS_MAX_QMAX={PALLAS_MAX_QMAX}; "
+            "use the scan aligner")
+    n = 1
+    for s in lead:
+        n *= s
+    qs_f = qs.reshape(n, qmax)
+    qlens_f = qlens.reshape(n).astype(jnp.int32)
+    ts_f = ts.reshape(n, ts.shape[-1])
+    tlens_f = tlens.reshape(n).astype(jnp.int32)
+
+    offs = jax.vmap(
+        lambda ql, tl: compute_offsets(ql, tl, qmax, B, maxshift)
+    )(qlens_f, tlens_f)
+    ismatch = jax.vmap(
+        lambda q, t, o: compute_ismatch(q, t, o, B, maxshift)
+    )(qs_f, ts_f, offs)
+
+    if qmax % ROWBLOCK != 0:
+        raise ValueError(f"qmax={qmax} must be a multiple of {ROWBLOCK}")
+    kern = functools.partial(
+        _kernel, qmax=qmax, band=B, maxshift=maxshift, params=params)
+    nb = qmax // ROWBLOCK
+    moves, fin = pl.pallas_call(
+        kern,
+        grid=(n, nb),
+        in_specs=[
+            pl.BlockSpec((1, 1, qmax), lambda i, r: (i, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, 1), lambda i, r: (i, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, 1), lambda i, r: (i, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, ROWBLOCK, B), lambda i, r: (i, r, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, ROWBLOCK, B), lambda i, r: (i, r, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, B), lambda i, r: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, qmax, B), jnp.uint8),
+            jax.ShapeDtypeStruct((n, 8, B), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((_CH, B), jnp.int32)],
+        interpret=interpret,
+    )(offs[:, None, :], qlens_f[:, None, None], tlens_f[:, None, None],
+      ismatch)
+
+    # final-row extraction (mirrors ops/banded.py global-mode epilogue)
+    off_fin = offs[:, -1]
+    laneT = tlens_f - off_fin
+    reachable = (laneT >= 0) & (laneT < B)
+    lane = jnp.clip(laneT, 0, B - 1)
+    take = jax.vmap(lambda f, l: f[:, l])(fin, lane)  # (n, 8)
+    res = BandedResult(
+        score=jnp.where(reachable, take[:, 0], NEG).reshape(lead),
+        qb=jnp.zeros(lead, jnp.int32),
+        qe=qlens_f.reshape(lead),
+        tb=jnp.zeros(lead, jnp.int32),
+        te=tlens_f.reshape(lead),
+        aln=jnp.where(reachable, take[:, 2], 0).reshape(lead),
+        mat=jnp.where(reachable, take[:, 1], 0).reshape(lead),
+    )
+    moves = moves.reshape(lead + (qmax, B))
+    offs = offs.reshape(lead + (qmax,))
+    return res, moves, offs

@@ -1,0 +1,109 @@
+"""Differential tests: Pallas banded kernel vs the scan implementation.
+
+The lax.scan aligner (ops/banded.py) is the spec; the Pallas kernel
+(ops/banded_pallas.py) must be bit-exact in global+moves mode: same scores,
+same stats, same band offsets, and identical move bytes for every live row
+(rows beyond qlen carry frozen garbage in both — not compared).
+
+On CPU (the test mesh) the kernel runs in interpret mode, so shapes are
+kept small.
+"""
+
+import numpy as np
+import pytest
+
+from ccsx_tpu.config import AlignParams
+from ccsx_tpu.ops import banded, banded_pallas
+from ccsx_tpu.utils import synth
+
+
+def _random_case(rng, Qmax, Tmax, tmin=40, tspan=160):
+    tl = int(rng.integers(tmin, tmin + tspan))
+    tpl = rng.integers(0, 4, tl).astype(np.uint8)
+    q = synth.mutate(rng, tpl, 0.03, 0.05, 0.05)[:Qmax]
+    qs = np.full(Qmax, banded.PAD, np.uint8)
+    qs[: len(q)] = q
+    ts = np.full(Tmax, banded.PAD, np.uint8)
+    ts[:tl] = tpl
+    return qs, np.int32(len(q)), ts, np.int32(tl)
+
+
+def _compare(qs, qlens, ts, tlens, params):
+    scan_f = banded.make_batched("global", params, with_moves=True)
+    r1, m1, o1 = scan_f(qs, qlens, ts, tlens)
+    r2, m2, o2 = banded_pallas.batched_align_global_moves(
+        qs, qlens, ts, tlens, params, interpret=True)
+    np.testing.assert_array_equal(np.asarray(r1.score), np.asarray(r2.score))
+    np.testing.assert_array_equal(np.asarray(r1.mat), np.asarray(r2.mat))
+    np.testing.assert_array_equal(np.asarray(r1.aln), np.asarray(r2.aln))
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    m1, m2 = np.asarray(m1), np.asarray(m2)
+    for i in range(len(qlens)):
+        ql = int(qlens[i])
+        np.testing.assert_array_equal(
+            m1[i, :ql], m2[i, :ql], err_msg=f"moves mismatch, problem {i}")
+
+
+def test_bit_exact_random_batch():
+    rng = np.random.default_rng(7)
+    Qmax, Tmax, N = 256, 256, 5
+    cases = [_random_case(rng, Qmax, Tmax) for _ in range(N)]
+    qs = np.stack([c[0] for c in cases])
+    qlens = np.array([c[1] for c in cases], np.int32)
+    ts = np.stack([c[2] for c in cases])
+    tlens = np.array([c[3] for c in cases], np.int32)
+    _compare(qs, qlens, ts, tlens, AlignParams())
+
+
+def test_empty_and_extreme_rows():
+    """Padding rows (qlen=0), very short queries, and full-length queries."""
+    rng = np.random.default_rng(11)
+    Qmax, Tmax = 128, 128
+    tl = 100
+    tpl = rng.integers(0, 4, tl).astype(np.uint8)
+    ts_row = np.full(Tmax, banded.PAD, np.uint8)
+    ts_row[:tl] = tpl
+    qs = np.full((3, Qmax), banded.PAD, np.uint8)
+    qlens = np.zeros(3, np.int32)
+    # row 0: empty (padding row); row 1: tiny query; row 2: qlen == Qmax
+    qs[1, :5] = tpl[:5]
+    qlens[1] = 5
+    full = synth.mutate(rng, tpl, 0.02, 0.3, 0.02)
+    full = np.concatenate([full, rng.integers(0, 4, Qmax).astype(np.uint8)])
+    qs[2] = full[:Qmax]
+    qlens[2] = Qmax
+    ts = np.broadcast_to(ts_row, (3, Tmax)).copy()
+    tlens = np.full(3, tl, np.int32)
+    _compare(qs, qlens, ts, tlens, AlignParams())
+
+
+def test_leading_batch_dims():
+    """(Z, P, Qmax) nested batching reshapes correctly."""
+    rng = np.random.default_rng(3)
+    Qmax, Tmax = 128, 128
+    cases = [_random_case(rng, Qmax, Tmax, tmin=40, tspan=60)
+             for _ in range(4)]
+    qs = np.stack([c[0] for c in cases]).reshape(2, 2, Qmax)
+    qlens = np.array([c[1] for c in cases], np.int32).reshape(2, 2)
+    ts = np.stack([c[2] for c in cases]).reshape(2, 2, Tmax)
+    tlens = np.array([c[3] for c in cases], np.int32).reshape(2, 2)
+    r, moves, offs = banded_pallas.batched_align_global_moves(
+        qs, qlens, ts, tlens, AlignParams(), interpret=True)
+    assert r.score.shape == (2, 2)
+    assert moves.shape == (2, 2, Qmax, 128)
+    assert offs.shape == (2, 2, Qmax)
+    flat = banded_pallas.batched_align_global_moves(
+        qs.reshape(4, Qmax), qlens.reshape(4), ts.reshape(4, Tmax),
+        tlens.reshape(4), AlignParams(), interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(r.score).ravel(), np.asarray(flat[0].score))
+
+
+def test_qmax_cap():
+    with pytest.raises(ValueError):
+        banded_pallas.batched_align_global_moves(
+            np.zeros((1, banded_pallas.PALLAS_MAX_QMAX + 8), np.uint8),
+            np.zeros(1, np.int32),
+            np.zeros((1, 128), np.uint8),
+            np.zeros(1, np.int32),
+            AlignParams(), interpret=True)
