@@ -1,0 +1,89 @@
+"""Native (C++) IO layer loader.
+
+Builds ``libccsx_io.so`` from io_native.cpp on first use if a compiler is
+present, loads it via ctypes, and exposes ``lib()``.  Import never fails:
+callers check ``available()`` and fall back to the pure-Python parsers
+(ccsx_tpu.io.fastx / ccsx_tpu.io.bam) when the toolchain is absent.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libccsx_io.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-s", "-C", _DIR],
+            check=True, capture_output=True, timeout=120,
+        )
+        return os.path.exists(_SO)
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    lib.ccsx_open.restype = c.c_void_p
+    lib.ccsx_open.argtypes = [c.c_char_p, c.c_int]
+    lib.ccsx_set_filter.restype = None
+    lib.ccsx_set_filter.argtypes = [c.c_void_p, c.c_int32, c.c_int64,
+                                    c.c_int64]
+    lib.ccsx_next_zmw.restype = c.c_int
+    lib.ccsx_next_zmw.argtypes = [
+        c.c_void_p,
+        c.POINTER(c.c_char_p), c.POINTER(c.c_char_p),
+        c.POINTER(c.POINTER(c.c_uint8)), c.POINTER(c.c_int64),
+        c.POINTER(c.POINTER(c.c_int32)), c.POINTER(c.c_int32),
+    ]
+    lib.ccsx_next_record.restype = c.c_int
+    lib.ccsx_next_record.argtypes = [
+        c.c_void_p,
+        c.POINTER(c.c_char_p), c.POINTER(c.c_char_p),
+        c.POINTER(c.POINTER(c.c_uint8)), c.POINTER(c.c_int64),
+        c.POINTER(c.POINTER(c.c_uint8)), c.POINTER(c.c_int64),
+    ]
+    lib.ccsx_error.restype = c.c_char_p
+    lib.ccsx_error.argtypes = [c.c_void_p]
+    lib.ccsx_close.restype = None
+    lib.ccsx_close.argtypes = [c.c_void_p]
+    for name in ("ccsx_encode", "ccsx_revcomp_ascii", "ccsx_revcomp_codes"):
+        fn = getattr(lib, name)
+        fn.restype = None
+        fn.argtypes = [c.POINTER(c.c_uint8), c.c_int64, c.POINTER(c.c_uint8)]
+    return lib
+
+
+def lib():
+    """The loaded native library, or None when unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or (
+            os.path.getmtime(_SO)
+            < os.path.getmtime(os.path.join(_DIR, "io_native.cpp"))
+        ):
+            if not _build():
+                return None
+        try:
+            _lib = _bind(ctypes.CDLL(_SO))
+        except OSError:
+            _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return lib() is not None

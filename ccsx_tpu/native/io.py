@@ -1,0 +1,130 @@
+"""ctypes wrappers over the native IO library.
+
+Same Python-facing types as the fallback parsers (FastxRecord, Zmw), so the
+pipeline can switch between paths transparently.  The native streamer does
+the record parse, group-by-hole, and count/length filters in C++
+(seqio.h:152-201, main.c:659-672 semantics); the rare hole-exclusion check
+(-X) stays here.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Iterator, Optional
+
+import numpy as np
+
+from ccsx_tpu.config import CcsConfig
+from ccsx_tpu.io.fastx import FastxRecord
+from ccsx_tpu.io.zmw import InvalidZmwName, Zmw
+from ccsx_tpu import native
+
+
+class NativeStreamError(ValueError):
+    pass
+
+
+def _open(path: str, is_bam: bool):
+    L = native.lib()
+    if L is None:
+        raise RuntimeError("native IO library unavailable")
+    h = L.ccsx_open(path.encode(), 1 if is_bam else 0)
+    if not h:
+        raise OSError(f"cannot open {path!r}")
+    return L, h
+
+
+def read_records_native(path: str, is_bam: bool) -> Iterator[FastxRecord]:
+    """Record-level stream (FASTA/Q or BAM) through the native parser."""
+    L, h = _open(path, is_bam)
+    c = ctypes
+    name, comment = c.c_char_p(), c.c_char_p()
+    seq, qual = c.POINTER(c.c_uint8)(), c.POINTER(c.c_uint8)()
+    seq_len, qual_len = c.c_int64(), c.c_int64()
+    try:
+        while True:
+            rc = L.ccsx_next_record(h, c.byref(name), c.byref(comment),
+                                    c.byref(seq), c.byref(seq_len),
+                                    c.byref(qual), c.byref(qual_len))
+            if rc == 0:
+                return
+            if rc < 0:
+                raise NativeStreamError(L.ccsx_error(h).decode())
+            s = c.string_at(seq, seq_len.value)
+            q = (c.string_at(qual, qual_len.value)
+                 if qual_len.value >= 0 else None)
+            yield FastxRecord(
+                name=name.value.decode(),
+                comment=comment.value.decode(),
+                seq=s, qual=q)
+    finally:
+        L.ccsx_close(h)
+
+
+def stream_zmws_native(path: str, cfg: CcsConfig) -> Iterator[Zmw]:
+    """Filtered ZMW stream through the native group-by-hole streamer.
+
+    Opens eagerly — a bad path raises OSError here, not at first next().
+    """
+    L, h = _open(path, cfg.is_bam)
+    c = ctypes
+    L.ccsx_set_filter(h, cfg.min_pass_count, cfg.min_subread_len,
+                      cfg.max_subread_len)
+    return _zmw_gen(L, h, cfg)
+
+
+def _zmw_gen(L, h, cfg: CcsConfig) -> Iterator[Zmw]:
+    c = ctypes
+    movie, hole = c.c_char_p(), c.c_char_p()
+    seqs = c.POINTER(c.c_uint8)()
+    total = c.c_int64()
+    lens = c.POINTER(c.c_int32)()
+    n = c.c_int32()
+    try:
+        while True:
+            rc = L.ccsx_next_zmw(h, c.byref(movie), c.byref(hole),
+                                 c.byref(seqs), c.byref(total),
+                                 c.byref(lens), c.byref(n))
+            if rc == -1:
+                return
+            if rc == -2:
+                raise InvalidZmwName(L.ccsx_error(h).decode())
+            if rc < 0:
+                raise NativeStreamError(L.ccsx_error(h).decode())
+            hole_s = hole.value.decode()
+            if cfg.exclude_holes and hole_s in cfg.exclude_holes:
+                continue
+            lens_np = np.ctypeslib.as_array(lens, shape=(n.value,)).copy()
+            offs = np.zeros(n.value, dtype=np.int32)
+            if n.value > 1:
+                np.cumsum(lens_np[:-1], out=offs[1:])
+            yield Zmw(
+                movie=movie.value.decode(), hole=hole_s,
+                seqs=c.string_at(seqs, total.value),
+                lens=lens_np, offs=offs)
+    finally:
+        L.ccsx_close(h)
+
+
+def encode_native(seq: bytes) -> Optional[np.ndarray]:
+    L = native.lib()
+    if L is None:
+        return None
+    n = len(seq)
+    out = np.empty(n, dtype=np.uint8)
+    L.ccsx_encode(
+        ctypes.cast(ctypes.c_char_p(seq), ctypes.POINTER(ctypes.c_uint8)),
+        n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out
+
+
+def revcomp_codes_native(codes: np.ndarray) -> Optional[np.ndarray]:
+    L = native.lib()
+    if L is None:
+        return None
+    codes = np.ascontiguousarray(codes, dtype=np.uint8)
+    out = np.empty(len(codes), dtype=np.uint8)
+    L.ccsx_revcomp_codes(
+        codes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        len(codes), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out

@@ -1,0 +1,473 @@
+// ccsx_tpu native IO: gzip-streamed FASTA/FASTQ + BAM readers and the
+// ZMW group-by-hole streamer, as a C shared library consumed via ctypes.
+//
+// This is the [NATIVE] L1 of the framework (SURVEY.md §7.1 io_native),
+// re-implementing the semantics of the reference's IO stack:
+//   * FASTA/FASTQ state machine  — kseq.h:177-218 (records at '>'/'@',
+//     multi-line seq, '+' quality section read until length match);
+//   * BAM record walk            — bamlite.c:78-165 (BAM-through-gzip,
+//     magic+header parse, record parse, 4-bit nibble seq decode via the
+//     =ACMGRSVTWYHKDBN table bamlite.h:86/seqio.h:92, qual phred+33
+//     clamped at 126 seqio.h:113);
+//   * ZMW group-by-hole streamer — seqio.h:152-201 (name split on '/'
+//     expecting movie/hole/region, consecutive same-hole records
+//     concatenated, one-record lookahead carry);
+//   * read-step filters          — main.c:659-672 (min pass count, total
+//     length bounds); hole exclusion stays host-side (tiny set, rare).
+//   * 2-bit encode / reverse-complement tables — main.c:222-241,
+//     seqio.h:120-148.
+//
+// Ownership: all pointers returned through the API reference buffers owned
+// by the reader handle and are valid until the next next_* call on that
+// handle. The Python wrapper copies them out immediately.
+
+#include <zlib.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr int64_t kBufSize = 1 << 16;
+
+// ---- decode tables -------------------------------------------------------
+
+// 4-bit BAM code -> ASCII (bamlite.h:86, seqio.h:92)
+const char kNt16[] = "=ACMGRSVTWYHKDBN";
+
+struct Tables {
+  uint8_t enc[256];      // ASCII -> 0..3 base, 4 other
+  uint8_t comp[256];     // ASCII complement (seqio.h:120-137)
+  uint8_t nib[256][2];   // packed byte -> two ASCII bases
+  Tables() {
+    for (int i = 0; i < 256; i++) enc[i] = 4;
+    const char* b = "ACGT";
+    for (int i = 0; i < 4; i++) {
+      enc[(uint8_t)b[i]] = (uint8_t)i;
+      enc[(uint8_t)(b[i] + 32)] = (uint8_t)i;
+    }
+    for (int i = 0; i < 256; i++) comp[i] = (uint8_t)i;
+    const char* from = "ACGTacgtUuNn";
+    const char* to = "TGCAtgcaAaNn";
+    for (int i = 0; from[i]; i++) comp[(uint8_t)from[i]] = (uint8_t)to[i];
+    for (int i = 0; i < 256; i++) {
+      nib[i][0] = (uint8_t)kNt16[i >> 4];
+      nib[i][1] = (uint8_t)kNt16[i & 0xF];
+    }
+  }
+};
+const Tables kT;
+
+// ---- buffered gz stream --------------------------------------------------
+
+struct GzStream {
+  gzFile gz = nullptr;
+  std::vector<uint8_t> buf;
+  int64_t begin = 0, end = 0;
+  bool eof = false;
+  bool err = false;  // corrupt/truncated gzip stream (gzread < 0)
+
+  bool open(const char* path) {
+    if (std::strcmp(path, "-") == 0)
+      gz = gzdopen(0, "r");
+    else
+      gz = gzopen(path, "r");
+    if (gz) { buf.resize(kBufSize); return true; }
+    return false;
+  }
+  void close() {
+    if (gz) { gzclose(gz); gz = nullptr; }
+  }
+  bool fill() {
+    if (eof) return false;
+    int n = gzread(gz, buf.data(), (unsigned)buf.size());
+    begin = 0;
+    end = n > 0 ? n : 0;
+    if (n < 0) { eof = true; err = true; return false; }
+    if (n == 0) {
+      // distinguish clean EOF from a truncated deflate stream
+      int errnum = Z_OK;
+      gzerror(gz, &errnum);
+      if (errnum != Z_OK && errnum != Z_STREAM_END) err = true;
+      eof = true;
+      return false;
+    }
+    return true;
+  }
+  // next byte or -1 at EOF
+  int getc() {
+    if (begin >= end && !fill()) return -1;
+    return buf[begin++];
+  }
+  // read exactly n bytes; returns bytes read
+  int64_t read(uint8_t* dst, int64_t n) {
+    int64_t got = 0;
+    while (got < n) {
+      if (begin >= end && !fill()) break;
+      int64_t take = end - begin;
+      if (take > n - got) take = n - got;
+      std::memcpy(dst + got, buf.data() + begin, (size_t)take);
+      begin += take;
+      got += take;
+    }
+    return got;
+  }
+  // append bytes into `out` until delimiter class hit (dropped from out).
+  // delim: 0 = isspace, 1 = line ('\n', with '\r' stripped by caller).
+  // returns: >=0 delimiter byte consumed, -1 EOF (out may hold a tail).
+  int getuntil(int delim, std::string* out) {
+    for (;;) {
+      if (begin >= end && !fill()) return -1;
+      int64_t i = begin;
+      if (delim == 0) {
+        while (i < end && !isspace(buf[i])) i++;
+      } else {
+        while (i < end && buf[i] != '\n') i++;
+      }
+      out->append((const char*)buf.data() + begin, (size_t)(i - begin));
+      if (i < end) {
+        int c = buf[i];
+        begin = i + 1;
+        return c;
+      }
+      begin = i;
+    }
+  }
+};
+
+// ---- record (one subread) ------------------------------------------------
+
+struct Record {
+  std::string name, comment, seq, qual;
+  bool has_qual = false;
+  void clear() {
+    name.clear(); comment.clear(); seq.clear(); qual.clear();
+    has_qual = false;
+  }
+};
+
+// ---- FASTA/FASTQ reader (kseq.h:177-218 semantics) ----------------------
+
+struct FastxReader {
+  GzStream s;
+  int last_char = 0;  // 0 = need to scan for marker; else the marker byte
+
+  // returns: 1 record, 0 EOF, -2 malformed (qual length mismatch),
+  // -3 corrupt gzip stream
+  int next(Record* r) {
+    r->clear();
+    int c = last_char;
+    if (c == 0) {
+      while ((c = s.getc()) != -1 && c != '>' && c != '@') {}
+      if (c == -1) return s.err ? -3 : 0;
+    }
+    last_char = 0;
+    int marker = c;
+    // name = first whitespace token; comment = rest of line
+    c = s.getuntil(0, &r->name);
+    if (c == -1) {
+      if (s.err) return -3;
+      return r->name.empty() ? 0 : 1;
+    }
+    if (c != '\n') {
+      c = s.getuntil(1, &r->comment);
+      // byte-parity with io/fastx.py: strip only line terminators; keep
+      // any interior/trailing spaces exactly as Python's split(None, 1)
+      while (!r->comment.empty() && r->comment.back() == '\r')
+        r->comment.pop_back();
+      // leading whitespace from the delimiter run
+      size_t b = 0;
+      while (b < r->comment.size() &&
+             (r->comment[b] == ' ' || r->comment[b] == '\t' ||
+              r->comment[b] == '\r'))
+        b++;
+      r->comment.erase(0, b);
+    }
+    // sequence lines until '>', '@' or '+'
+    while ((c = s.getc()) != -1 && c != '>' && c != '@' && c != '+') {
+      if (c == '\n' || c == '\r') continue;
+      r->seq.push_back((char)c);
+      std::string tmp;
+      int d = s.getuntil(1, &tmp);
+      while (!tmp.empty() && tmp.back() == '\r') tmp.pop_back();
+      r->seq.append(tmp);
+      if (d == -1) { c = -1; break; }
+    }
+    if (c == '>' || c == '@') { last_char = c; return 1; }
+    if (s.err) return -3;    // truncated gzip mid-sequence
+    if (c != '+') return 1;  // EOF after sequence
+    // '+' line: skip to end of line, then read quality until length match
+    { std::string skip; if (s.getuntil(1, &skip) == -1) return -2; }
+    while (r->qual.size() < r->seq.size()) {
+      std::string line;
+      int d = s.getuntil(1, &line);
+      while (!line.empty() && line.back() == '\r') line.pop_back();
+      r->qual.append(line);
+      if (d == -1) break;
+    }
+    if (s.err) return -3;
+    if (r->qual.size() != r->seq.size()) return -2;
+    // kseq parity: the quality section is *parsed* after any record, but
+    // reported only for '@' records (io/fastx.py does the same).
+    r->has_qual = (marker == '@');
+    if (!r->has_qual) r->qual.clear();
+    return 1;
+  }
+};
+
+// ---- BAM reader (bamlite.c:78-165 semantics) ----------------------------
+
+struct BamReader {
+  GzStream s;
+  bool header_done = false;
+  std::vector<uint8_t> block;
+
+  // returns 0 ok, -3 bad header
+  int read_header() {
+    uint8_t magic[4];
+    if (s.read(magic, 4) != 4 || std::memcmp(magic, "BAM\1", 4) != 0)
+      return -3;
+    int32_t l_text;
+    if (s.read((uint8_t*)&l_text, 4) != 4 || l_text < 0) return -3;
+    std::vector<uint8_t> skip((size_t)l_text);
+    if (s.read(skip.data(), l_text) != l_text) return -3;
+    int32_t n_ref;
+    if (s.read((uint8_t*)&n_ref, 4) != 4 || n_ref < 0) return -3;
+    for (int32_t i = 0; i < n_ref; i++) {
+      int32_t l_name;
+      if (s.read((uint8_t*)&l_name, 4) != 4 || l_name < 0) return -3;
+      skip.resize((size_t)l_name + 4);
+      if (s.read(skip.data(), l_name + 4) != l_name + 4) return -3;
+    }
+    header_done = true;
+    return 0;
+  }
+
+  // returns: 1 record, 0 clean EOF, -3 truncated/bad stream
+  int next(Record* r) {
+    if (!header_done) {
+      int rc = read_header();
+      if (rc != 0) return rc;
+    }
+    r->clear();
+    int32_t block_size;
+    int64_t got = s.read((uint8_t*)&block_size, 4);
+    if (got == 0) return s.err ? -3 : 0;  // clean EOF (bamlite.c:141)
+    if (got != 4 || block_size < 32) return -3;
+    block.resize((size_t)block_size);
+    if (s.read(block.data(), block_size) != block_size) return -3;
+    const uint8_t* p = block.data();
+    uint8_t l_read_name = p[8];
+    uint16_t n_cigar;
+    int32_t l_seq;
+    std::memcpy(&n_cigar, p + 12, 2);
+    std::memcpy(&l_seq, p + 16, 4);
+    if (l_seq < 0) return -3;  // corrupt record; resize would throw
+    int64_t off = 32;
+    if (off + l_read_name > block_size) return -3;
+    r->name.assign((const char*)p + off,
+                   l_read_name > 0 ? (size_t)(l_read_name - 1) : 0);
+    off += l_read_name;
+    off += 4 * (int64_t)n_cigar;
+    int64_t nseq_bytes = (l_seq + 1) / 2;
+    if (off + nseq_bytes + l_seq > block_size) return -3;
+    r->seq.resize((size_t)l_seq);
+    for (int64_t i = 0; i < nseq_bytes; i++) {
+      const uint8_t* two = kT.nib[p[off + i]];
+      r->seq[(size_t)(2 * i)] = (char)two[0];
+      if (2 * i + 1 < l_seq) r->seq[(size_t)(2 * i + 1)] = (char)two[1];
+    }
+    off += nseq_bytes;
+    r->qual.resize((size_t)l_seq);
+    for (int64_t i = 0; i < l_seq; i++) {
+      int q = p[off + i] + 33;            // seqio.h:113
+      r->qual[(size_t)i] = (char)(q > 126 ? 126 : q);
+    }
+    r->has_qual = true;
+    return 1;
+  }
+};
+
+// ---- ZMW group-by-hole streamer (seqio.h:152-201) ------------------------
+
+struct Reader {
+  bool is_bam = false;
+  FastxReader fx;
+  BamReader bam;
+  std::string error;
+
+  // filters (main.c:659-672); 0/absent = keep everything
+  int32_t min_passes = 0;
+  int64_t min_total = 0, max_total = 0;
+
+  // lookahead carry (seqio.h:158-163)
+  Record carry;
+  bool have_carry = false;
+  bool stream_done = false;
+
+  // current hole output
+  std::string movie, hole;
+  std::string seqs;
+  std::vector<int32_t> lens;
+
+  // split "movie/hole/region"; returns false if not exactly 3 fields
+  static bool split3(const std::string& name, std::string* m, std::string* h) {
+    size_t a = name.find('/');
+    if (a == std::string::npos) return false;
+    size_t b = name.find('/', a + 1);
+    if (b == std::string::npos) return false;
+    if (name.find('/', b + 1) != std::string::npos) return false;
+    m->assign(name, 0, a);
+    h->assign(name, a + 1, b - a - 1);
+    return true;
+  }
+
+  int next_record(Record* r) {
+    return is_bam ? bam.next(r) : fx.next(r);
+  }
+
+  bool keep() const {
+    if (min_passes > 0 && (int32_t)lens.size() < min_passes) return false;
+    int64_t total = (int64_t)seqs.size();
+    if (max_total > 0 && total > max_total) return false;
+    if (total < min_total) return false;
+    return true;
+  }
+
+  // returns n_passes >= 0; -1 EOF; -2 invalid name; -3 stream error
+  int next_zmw() {
+    for (;;) {
+      movie.clear(); hole.clear(); seqs.clear(); lens.clear();
+      if (stream_done && !have_carry) return -1;
+      if (have_carry) {
+        if (!split3(carry.name, &movie, &hole)) {
+          error = "invalid zmw name :" + carry.name;
+          return -2;
+        }
+        seqs.append(carry.seq);
+        lens.push_back((int32_t)carry.seq.size());
+        have_carry = false;
+      }
+      for (;;) {
+        Record r;
+        int rc = next_record(&r);
+        if (rc == 0) { stream_done = true; break; }
+        if (rc == -2) { error = "malformed FASTQ record: " + r.name; return -3; }
+        if (rc < 0) { error = "truncated or corrupt input stream"; return -3; }
+        std::string m, h;
+        if (!split3(r.name, &m, &h)) {
+          error = "invalid zmw name :" + r.name;
+          return -2;
+        }
+        if (lens.empty()) {
+          movie.swap(m); hole.swap(h);
+        } else if (m != movie || h != hole) {
+          carry = std::move(r);
+          have_carry = true;
+          break;
+        }
+        seqs.append(r.seq);
+        lens.push_back((int32_t)r.seq.size());
+      }
+      if (lens.empty()) return -1;
+      if (keep()) return (int)lens.size();
+      // filtered: loop to the next hole without crossing the API boundary
+    }
+  }
+};
+
+}  // namespace
+
+// ---- C API ---------------------------------------------------------------
+
+extern "C" {
+
+void* ccsx_open(const char* path, int is_bam) {
+  Reader* r = new Reader();
+  r->is_bam = is_bam != 0;
+  GzStream& s = r->is_bam ? r->bam.s : r->fx.s;
+  if (!s.open(path)) { delete r; return nullptr; }
+  return r;
+}
+
+void ccsx_set_filter(void* h, int32_t min_passes, int64_t min_total,
+                     int64_t max_total) {
+  Reader* r = (Reader*)h;
+  r->min_passes = min_passes;
+  r->min_total = min_total;
+  r->max_total = max_total;
+}
+
+// Fetch the next (filtered) hole. Returns n_passes>=0, -1 EOF, -2 invalid
+// name, -3 stream error. Out pointers are valid until the next call.
+int ccsx_next_zmw(void* h, const char** movie, const char** hole,
+                  const uint8_t** seqs, int64_t* total_len,
+                  const int32_t** lens, int32_t* n_passes) {
+  Reader* r = (Reader*)h;
+  int rc = r->next_zmw();
+  if (rc >= 0) {
+    *movie = r->movie.c_str();
+    *hole = r->hole.c_str();
+    *seqs = (const uint8_t*)r->seqs.data();
+    *total_len = (int64_t)r->seqs.size();
+    *lens = r->lens.data();
+    *n_passes = (int32_t)r->lens.size();
+  }
+  return rc;
+}
+
+// Record-level access (no grouping). Returns 1 record, 0 EOF, -3 error.
+// qual_len is -1 when the record carries no quality (FASTA).
+int ccsx_next_record(void* h, const char** name, const char** comment,
+                     const uint8_t** seq, int64_t* seq_len,
+                     const uint8_t** qual, int64_t* qual_len) {
+  Reader* r = (Reader*)h;
+  r->carry.clear();
+  int rc = r->next_record(&r->carry);
+  if (rc == 1) {
+    *name = r->carry.name.c_str();
+    *comment = r->carry.comment.c_str();
+    *seq = (const uint8_t*)r->carry.seq.data();
+    *seq_len = (int64_t)r->carry.seq.size();
+    *qual = (const uint8_t*)r->carry.qual.data();
+    *qual_len = r->carry.has_qual ? (int64_t)r->carry.qual.size() : -1;
+  } else if (rc == -2) {
+    r->error = "malformed FASTQ record: " + r->carry.name;
+    rc = -3;
+  } else if (rc < 0) {
+    if (r->error.empty()) r->error = "truncated or invalid stream";
+    rc = -3;
+  }
+  return rc;
+}
+
+const char* ccsx_error(void* h) { return ((Reader*)h)->error.c_str(); }
+
+void ccsx_close(void* h) {
+  Reader* r = (Reader*)h;
+  GzStream& s = r->is_bam ? r->bam.s : r->fx.s;
+  s.close();
+  delete r;
+}
+
+// ---- encode / reverse-complement (main.c:222-241, seqio.h:120-148) ------
+
+void ccsx_encode(const uint8_t* ascii, int64_t n, uint8_t* out) {
+  for (int64_t i = 0; i < n; i++) out[i] = kT.enc[ascii[i]];
+}
+
+void ccsx_revcomp_ascii(const uint8_t* in, int64_t n, uint8_t* out) {
+  for (int64_t i = 0; i < n; i++) out[i] = kT.comp[in[n - 1 - i]];
+}
+
+void ccsx_revcomp_codes(const uint8_t* in, int64_t n, uint8_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    uint8_t c = in[n - 1 - i];
+    out[i] = c < 4 ? (uint8_t)(3 - c) : c;
+  }
+}
+
+}  // extern "C"

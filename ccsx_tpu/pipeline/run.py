@@ -25,23 +25,32 @@ from ccsx_tpu.utils.journal import Journal
 from ccsx_tpu.utils.metrics import Metrics
 
 
-def open_input(path: str, cfg: CcsConfig):
-    """Record iterator for BAM or FASTA/Q input ('-' = stdin).
+def open_zmw_stream(path: str, cfg: CcsConfig):
+    """Filtered ZMW iterator for BAM or FASTA/Q input ('-' = stdin).
 
-    Opens the file eagerly — the parsers are generators, and a deferred
-    open() would crash past the caller's error handling.
+    Uses the native C++ streamer (parser + group-by-hole + filters in one
+    pass, ccsx_tpu/native) when the library is available and the input is a
+    real path; otherwise the pure-Python parsers.  Opens the file eagerly —
+    the parsers are generators, and a deferred open() would crash past the
+    caller's error handling.
     """
+    from ccsx_tpu import native
+
+    if path != "-" and native.available():
+        from ccsx_tpu.native.io import stream_zmws_native
+
+        return stream_zmws_native(path, cfg)
     f = sys.stdin.buffer if path == "-" else open(path, "rb")
-    if cfg.is_bam:
-        return bam_mod.read_bam_records(f)
-    return fastx.read_fastx(f)
+    records = (bam_mod.read_bam_records(f) if cfg.is_bam
+               else fastx.read_fastx(f))
+    return zmw.stream_zmws(records, cfg)
 
 
 def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
                  journal_path: Optional[str] = None) -> int:
     try:
-        records = open_input(in_path, cfg)
-    except OSError as e:
+        stream = open_zmw_stream(in_path, cfg)
+    except (OSError, RuntimeError) as e:
         print(f"Error: Failed to open infile! ({e})", file=sys.stderr)
         return 1
     journal = Journal.load_or_create(journal_path, input_id=in_path)
@@ -80,7 +89,6 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
         if cfg.threads > 1 else None
     pending = collections.deque()
     try:
-        stream = zmw.stream_zmws(records, cfg)
         while True:
             try:
                 z = next(stream)

@@ -1,0 +1,183 @@
+"""Differential tests: native C++ IO vs the pure-Python parsers.
+
+Every stream the Python fallback can parse, the native path must parse to
+identical records/holes (SURVEY.md §7.2 step 1: byte-identical grouping).
+"""
+
+import gzip
+
+import numpy as np
+import pytest
+
+from ccsx_tpu import native
+from ccsx_tpu.config import CcsConfig
+from ccsx_tpu.io import bam as bam_mod
+from ccsx_tpu.io import fastx, zmw
+from ccsx_tpu.ops import encode as enc
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable")
+
+
+def _native_records(path):
+    from ccsx_tpu.native.io import read_records_native
+    return list(read_records_native(str(path), is_bam=False))
+
+
+def _records_equal(a, b):
+    assert len(a) == len(b)
+    for ra, rb in zip(a, b):
+        assert ra.name == rb.name
+        assert ra.comment == rb.comment
+        assert ra.seq == rb.seq
+        assert ra.qual == rb.qual
+
+
+FASTA = b""">m1/1/0_5 first comment here
+ACGTA
+>m1/1/5_9
+CG
+TA
+>m1/2/0_4\tx
+GGGG
+"""
+
+FASTQ = b"""@m2/7/0_4 c
+ACGT
++
+IIII
+@m2/7/4_10
+AAC
+GTT
++anything
+IIIII
+I
+"""
+
+
+def test_fasta_parity(tmp_path):
+    p = tmp_path / "a.fa"
+    p.write_bytes(FASTA)
+    _records_equal(_native_records(p), list(fastx.read_fastx(str(p))))
+
+
+def test_fastq_parity(tmp_path):
+    p = tmp_path / "a.fq"
+    p.write_bytes(FASTQ)
+    recs = _native_records(p)
+    _records_equal(recs, list(fastx.read_fastx(str(p))))
+    assert recs[0].qual == b"IIII"
+    assert recs[1].qual == b"IIIIII"
+
+
+def test_gzip_parity(tmp_path):
+    p = tmp_path / "a.fa.gz"
+    p.write_bytes(gzip.compress(FASTA + FASTQ))
+    _records_equal(_native_records(p), list(fastx.read_fastx(str(p))))
+
+
+def test_corrupt_gzip_raises(tmp_path):
+    p = tmp_path / "trunc.fa.gz"
+    blob = gzip.compress(FASTA * 50)
+    p.write_bytes(blob[: len(blob) // 2])  # truncated deflate stream
+    from ccsx_tpu.native.io import NativeStreamError
+    with pytest.raises(NativeStreamError):
+        _native_records(p)
+
+
+def test_fastq_bad_quality_length(tmp_path):
+    p = tmp_path / "bad.fq"
+    p.write_bytes(b"@r/1/0_4\nACGT\n+\nII\n")
+    from ccsx_tpu.native.io import NativeStreamError
+    with pytest.raises(NativeStreamError):
+        _native_records(p)
+
+
+def test_bam_parity(tmp_path):
+    p = tmp_path / "a.bam"
+    rng = np.random.default_rng(3)
+    records = []
+    for hole in (10, 11):
+        for i in range(4):
+            seq = bytes(rng.choice(list(b"ACGT"), 100 + 17 * i).tolist())
+            qual = bytes(rng.integers(0, 60, len(seq)).astype(np.uint8))
+            records.append((f"mv/{hole}/{i}", seq, qual))
+    bam_mod.write_bam(p, records)
+    from ccsx_tpu.native.io import read_records_native
+    got = list(read_records_native(str(p), is_bam=True))
+    want = list(bam_mod.read_bam_records(str(p)))
+    _records_equal(got, want)
+
+
+def test_bam_truncated(tmp_path):
+    p = tmp_path / "t.bam"
+    bam_mod.write_bam(p, [("m/1/0", b"ACGTACGT", b"\x10" * 8)])
+    raw = gzip.decompress(p.read_bytes())
+    p.write_bytes(gzip.compress(raw[:-3]))
+    from ccsx_tpu.native.io import NativeStreamError, read_records_native
+    with pytest.raises(NativeStreamError):
+        list(read_records_native(str(p), is_bam=True))
+
+
+def _mkfasta(tmp_path, holes):
+    """holes: list of (movie, hole, [seqlens]) -> path"""
+    rng = np.random.default_rng(0)
+    lines = []
+    for movie, hole, lens in holes:
+        for i, ln in enumerate(lens):
+            seq = "".join(rng.choice(list("ACGT"), ln).tolist())
+            lines.append(f">{movie}/{hole}/{i}\n{seq}\n")
+    p = tmp_path / "z.fa"
+    p.write_text("".join(lines))
+    return p
+
+
+def test_zmw_stream_parity(tmp_path):
+    cfg = CcsConfig(is_bam=False, min_subread_len=100, max_subread_len=10**6)
+    p = _mkfasta(tmp_path, [
+        ("m1", "1", [200] * 6),
+        ("m1", "2", [50] * 5),          # filtered: total too small? 250>100 ok
+        ("m1", "3", [300] * 3),         # filtered: too few passes (<5)
+        ("m2", "1", [400] * 7),
+    ])
+    from ccsx_tpu.native.io import stream_zmws_native
+    got = list(stream_zmws_native(str(p), cfg))
+    want = list(zmw.stream_zmws(fastx.read_fastx(str(p)), cfg))
+    assert len(got) == len(want)
+    for a, b in zip(got, want):
+        assert (a.movie, a.hole) == (b.movie, b.hole)
+        assert a.seqs == b.seqs
+        np.testing.assert_array_equal(a.lens, b.lens)
+        np.testing.assert_array_equal(a.offs, b.offs)
+
+
+def test_zmw_filters_and_exclusion(tmp_path):
+    p = _mkfasta(tmp_path, [
+        ("m", "1", [500] * 5),
+        ("m", "2", [500] * 5),
+        ("m", "3", [10] * 5),           # total 50 < min 100
+    ])
+    cfg = CcsConfig(is_bam=False, min_subread_len=100,
+                    exclude_holes=frozenset({"2"}))
+    from ccsx_tpu.native.io import stream_zmws_native
+    got = list(stream_zmws_native(str(p), cfg))
+    assert [z.hole for z in got] == ["1"]
+
+
+def test_zmw_invalid_name(tmp_path):
+    p = tmp_path / "bad.fa"
+    p.write_text(">notaholename\nACGT\n")
+    from ccsx_tpu.native.io import stream_zmws_native
+    cfg = CcsConfig(is_bam=False, min_subread_len=0)
+    with pytest.raises(zmw.InvalidZmwName):
+        list(stream_zmws_native(str(p), cfg))
+
+
+def test_encode_revcomp_native():
+    from ccsx_tpu.native.io import encode_native, revcomp_codes_native
+    seq = b"ACGTNacgtnXYZ-"
+    np.testing.assert_array_equal(encode_native(seq), enc.encode(seq))
+    rng = np.random.default_rng(1)
+    codes = rng.integers(0, 5, 257).astype(np.uint8)
+    np.testing.assert_array_equal(
+        revcomp_codes_native(codes), enc.revcomp_codes(codes))
