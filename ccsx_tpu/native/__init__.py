@@ -60,6 +60,29 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         fn = getattr(lib, name)
         fn.restype = None
         fn.argtypes = [c.POINTER(c.c_uint8), c.c_int64, c.POINTER(c.c_uint8)]
+    lib.ccsx_prefetch_open.restype = c.c_void_p
+    lib.ccsx_prefetch_open.argtypes = [c.c_char_p, c.c_int, c.c_int32,
+                                       c.c_int64, c.c_int64, c.c_int32]
+    lib.ccsx_prefetch_next.restype = c.c_int
+    lib.ccsx_prefetch_next.argtypes = lib.ccsx_next_zmw.argtypes
+    lib.ccsx_prefetch_error.restype = c.c_char_p
+    lib.ccsx_prefetch_error.argtypes = [c.c_void_p]
+    lib.ccsx_prefetch_close.restype = None
+    lib.ccsx_prefetch_close.argtypes = [c.c_void_p]
+    lib.ccsx_writer_open.restype = c.c_void_p
+    lib.ccsx_writer_open.argtypes = [c.c_char_p, c.c_int]
+    lib.ccsx_writer_put_fasta.restype = c.c_int
+    lib.ccsx_writer_put_fasta.argtypes = [c.c_void_p, c.c_char_p,
+                                          c.POINTER(c.c_uint8), c.c_int64]
+    lib.ccsx_writer_close.restype = c.c_int
+    lib.ccsx_writer_close.argtypes = [c.c_void_p]
+    lib.ccsx_align_scalar.restype = c.c_int
+    lib.ccsx_align_scalar.argtypes = [
+        c.POINTER(c.c_uint8), c.c_int64, c.POINTER(c.c_uint8), c.c_int64,
+        c.c_int, c.c_int, c.c_int, c.c_int, c.c_int,
+        c.POINTER(c.c_int64), c.POINTER(c.c_uint8), c.c_int64,
+        c.POINTER(c.c_int64),
+    ]
     return lib
 
 
@@ -72,9 +95,11 @@ def lib():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_SO) or (
-            os.path.getmtime(_SO)
-            < os.path.getmtime(os.path.join(_DIR, "io_native.cpp"))
+        import glob
+
+        srcs = glob.glob(os.path.join(_DIR, "*.cpp"))
+        if not os.path.exists(_SO) or any(
+            os.path.getmtime(_SO) < os.path.getmtime(s) for s in srcs
         ):
             if not _build():
                 return None

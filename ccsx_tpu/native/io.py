@@ -67,13 +67,13 @@ def stream_zmws_native(path: str, cfg: CcsConfig) -> Iterator[Zmw]:
     Opens eagerly — a bad path raises OSError here, not at first next().
     """
     L, h = _open(path, cfg.is_bam)
-    c = ctypes
     L.ccsx_set_filter(h, cfg.min_pass_count, cfg.min_subread_len,
                       cfg.max_subread_len)
-    return _zmw_gen(L, h, cfg)
+    return _zmw_gen(h, cfg, L.ccsx_next_zmw, L.ccsx_error, L.ccsx_close)
 
 
-def _zmw_gen(L, h, cfg: CcsConfig) -> Iterator[Zmw]:
+def _zmw_gen(h, cfg: CcsConfig, next_fn, error_fn, close_fn) -> Iterator[Zmw]:
+    """Shared drain loop for both native streamers (plain and prefetching)."""
     c = ctypes
     movie, hole = c.c_char_p(), c.c_char_p()
     seqs = c.POINTER(c.c_uint8)()
@@ -82,15 +82,15 @@ def _zmw_gen(L, h, cfg: CcsConfig) -> Iterator[Zmw]:
     n = c.c_int32()
     try:
         while True:
-            rc = L.ccsx_next_zmw(h, c.byref(movie), c.byref(hole),
-                                 c.byref(seqs), c.byref(total),
-                                 c.byref(lens), c.byref(n))
+            rc = next_fn(h, c.byref(movie), c.byref(hole),
+                         c.byref(seqs), c.byref(total),
+                         c.byref(lens), c.byref(n))
             if rc == -1:
                 return
             if rc == -2:
-                raise InvalidZmwName(L.ccsx_error(h).decode())
+                raise InvalidZmwName(error_fn(h).decode())
             if rc < 0:
-                raise NativeStreamError(L.ccsx_error(h).decode())
+                raise NativeStreamError(error_fn(h).decode())
             hole_s = hole.value.decode()
             if cfg.exclude_holes and hole_s in cfg.exclude_holes:
                 continue
@@ -103,7 +103,61 @@ def _zmw_gen(L, h, cfg: CcsConfig) -> Iterator[Zmw]:
                 seqs=c.string_at(seqs, total.value),
                 lens=lens_np, offs=offs)
     finally:
-        L.ccsx_close(h)
+        close_fn(h)
+
+
+def stream_zmws_prefetch(path: str, cfg: CcsConfig,
+                         queue_cap: int = 64) -> Iterator[Zmw]:
+    """Like stream_zmws_native, but parsing/grouping/filtering run on a
+    background C++ thread feeding a bounded queue — the native read step of
+    the 3-stage pipeline (kt_pipeline step 0, kthread.c:172-256).
+
+    Opens eagerly — a bad path raises OSError here, not at first next().
+    """
+    L = native.lib()
+    if L is None:
+        raise RuntimeError("native IO library unavailable")
+    h = L.ccsx_prefetch_open(path.encode(), 1 if cfg.is_bam else 0,
+                             cfg.min_pass_count, cfg.min_subread_len,
+                             cfg.max_subread_len, queue_cap)
+    if not h:
+        raise OSError(f"cannot open {path!r}")
+    return _zmw_gen(h, cfg, L.ccsx_prefetch_next, L.ccsx_prefetch_error,
+                    L.ccsx_prefetch_close)
+
+
+class NativeFastaWriter:
+    """Async ordered FASTA writer: fwrite runs on a C++ thread off the GIL.
+
+    Records appear in put() order (single consumer thread drains a FIFO),
+    matching the reference's ordered write step (main.c:707-718).
+    """
+
+    def __init__(self, path: str, append: bool = False):
+        L = native.lib()
+        if L is None:
+            raise RuntimeError("native IO library unavailable")
+        self._L = L
+        self._h = L.ccsx_writer_open(path.encode(), 1 if append else 0)
+        if not self._h:
+            raise OSError(f"cannot open {path!r} for write")
+
+    def put(self, name: str, seq: bytes) -> None:
+        if not self._h:
+            raise ValueError("writer is closed")
+        rc = self._L.ccsx_writer_put_fasta(
+            self._h, name.encode(),
+            ctypes.cast(ctypes.c_char_p(seq),
+                        ctypes.POINTER(ctypes.c_uint8)), len(seq))
+        if rc != 0:
+            raise OSError("write failed")
+
+    def close(self) -> None:
+        if self._h:
+            rc = self._L.ccsx_writer_close(self._h)
+            self._h = None
+            if rc != 0:
+                raise OSError("write failed")
 
 
 def encode_native(seq: bytes) -> Optional[np.ndarray]:

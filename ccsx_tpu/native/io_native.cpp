@@ -23,10 +23,14 @@
 
 #include <zlib.h>
 
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <deque>
+#include <mutex>
 #include <string>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -379,6 +383,126 @@ struct Reader {
   }
 };
 
+// ---- prefetching reader + ordered writer ---------------------------------
+//
+// The native equivalent of the reference's 3-step ordered pipeline
+// (kt_pipeline, kthread.c:172-256; wired 2 threads x 3 steps at main.c:856):
+// step 0 (read/group/filter) runs on a background thread here, step 1
+// (consensus) runs in the caller, step 2 (write) on the writer thread
+// below.  Chunk-order determinism is preserved because holes leave the
+// queue in stream order and the caller feeds the writer in that order.
+
+struct Hole {
+  std::string movie, hole, seqs;
+  std::vector<int32_t> lens;
+};
+
+struct Prefetcher {
+  Reader reader;
+  std::deque<Hole> queue;
+  std::mutex mu;
+  std::condition_variable cv_pop, cv_push;
+  size_t cap = 64;
+  int rc_final = 1;       // pending; set to <=-1 code when producer ends
+  bool done = false;
+  std::thread th;
+  Hole current;           // last popped: owns buffers handed to the caller
+
+  void run() {
+    for (;;) {
+      int rc = reader.next_zmw();
+      std::unique_lock<std::mutex> lk(mu);
+      if (rc < 0) { rc_final = rc; done = true; cv_pop.notify_all(); return; }
+      cv_push.wait(lk, [&] { return queue.size() < cap || done; });
+      if (done) return;  // closed under us
+      Hole h;
+      h.movie = reader.movie;
+      h.hole = reader.hole;
+      h.seqs.swap(reader.seqs);
+      h.lens.swap(reader.lens);
+      queue.push_back(std::move(h));
+      cv_pop.notify_one();
+    }
+  }
+
+  // same return codes as Reader::next_zmw
+  int pop() {
+    std::unique_lock<std::mutex> lk(mu);
+    cv_pop.wait(lk, [&] { return !queue.empty() || done; });
+    if (queue.empty()) return rc_final;
+    current = std::move(queue.front());
+    queue.pop_front();
+    cv_push.notify_one();
+    return (int)current.lens.size();
+  }
+
+  void close() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      done = true;
+    }
+    cv_push.notify_all();
+    cv_pop.notify_all();
+    if (th.joinable()) th.join();
+  }
+};
+
+struct Writer {
+  FILE* f = nullptr;
+  bool own = false;
+  std::deque<std::string> queue;
+  std::mutex mu;
+  std::condition_variable cv_pop, cv_push;
+  size_t cap = 256;
+  bool done = false, io_error = false;
+  std::thread th;
+
+  void run() {
+    for (;;) {
+      std::string item;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_pop.wait(lk, [&] { return !queue.empty() || done; });
+        if (queue.empty()) break;
+        item = std::move(queue.front());
+        queue.pop_front();
+        cv_push.notify_one();
+      }
+      if (!io_error &&
+          fwrite(item.data(), 1, item.size(), f) != item.size()) {
+        std::lock_guard<std::mutex> lk(mu);
+        io_error = true;
+      }
+    }
+  }
+
+  bool put(std::string s) {
+    std::unique_lock<std::mutex> lk(mu);
+    if (io_error) return false;
+    cv_push.wait(lk, [&] { return queue.size() < cap || done; });
+    if (done) return false;
+    queue.push_back(std::move(s));
+    cv_pop.notify_one();
+    return true;
+  }
+
+  // returns false on a prior write error
+  bool close() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      done = true;
+    }
+    cv_pop.notify_all();
+    cv_push.notify_all();
+    if (th.joinable()) th.join();
+    if (f) {
+      if (fflush(f) != 0) io_error = true;
+      if (own && fclose(f) != 0) io_error = true;
+    }
+    return !io_error;
+  }
+};
+
 }  // namespace
 
 // ---- C API ---------------------------------------------------------------
@@ -451,6 +575,88 @@ void ccsx_close(void* h) {
   GzStream& s = r->is_bam ? r->bam.s : r->fx.s;
   s.close();
   delete r;
+}
+
+// ---- prefetching reader (background read step) ---------------------------
+
+void* ccsx_prefetch_open(const char* path, int is_bam, int32_t min_passes,
+                         int64_t min_total, int64_t max_total,
+                         int32_t queue_cap) {
+  Prefetcher* p = new Prefetcher();
+  p->reader.is_bam = is_bam != 0;
+  GzStream& s = p->reader.is_bam ? p->reader.bam.s : p->reader.fx.s;
+  if (!s.open(path)) { delete p; return nullptr; }
+  p->reader.min_passes = min_passes;
+  p->reader.min_total = min_total;
+  p->reader.max_total = max_total;
+  if (queue_cap > 0) p->cap = (size_t)queue_cap;
+  p->th = std::thread([p] { p->run(); });
+  return p;
+}
+
+int ccsx_prefetch_next(void* h, const char** movie, const char** hole,
+                       const uint8_t** seqs, int64_t* total_len,
+                       const int32_t** lens, int32_t* n_passes) {
+  Prefetcher* p = (Prefetcher*)h;
+  int rc = p->pop();
+  if (rc >= 0) {
+    *movie = p->current.movie.c_str();
+    *hole = p->current.hole.c_str();
+    *seqs = (const uint8_t*)p->current.seqs.data();
+    *total_len = (int64_t)p->current.seqs.size();
+    *lens = p->current.lens.data();
+    *n_passes = (int32_t)p->current.lens.size();
+  }
+  return rc;
+}
+
+const char* ccsx_prefetch_error(void* h) {
+  return ((Prefetcher*)h)->reader.error.c_str();
+}
+
+void ccsx_prefetch_close(void* h) {
+  Prefetcher* p = (Prefetcher*)h;
+  p->close();
+  GzStream& s = p->reader.is_bam ? p->reader.bam.s : p->reader.fx.s;
+  s.close();
+  delete p;
+}
+
+// ---- ordered async writer (background write step) ------------------------
+
+void* ccsx_writer_open(const char* path, int append) {
+  Writer* w = new Writer();
+  if (std::strcmp(path, "-") == 0) {
+    w->f = stdout;
+  } else {
+    w->f = fopen(path, append ? "a" : "w");
+    w->own = true;
+  }
+  if (!w->f) { delete w; return nullptr; }
+  w->th = std::thread([w] { w->run(); });
+  return w;
+}
+
+// append one FASTA record (">name\nseq\n"); returns 0 ok, -1 on io error
+int ccsx_writer_put_fasta(void* h, const char* name, const uint8_t* seq,
+                          int64_t len) {
+  Writer* w = (Writer*)h;
+  std::string s;
+  s.reserve((size_t)len + std::strlen(name) + 3);
+  s.push_back('>');
+  s.append(name);
+  s.push_back('\n');
+  s.append((const char*)seq, (size_t)len);
+  s.push_back('\n');
+  return w->put(std::move(s)) ? 0 : -1;
+}
+
+// returns 0 ok, -1 if any write failed
+int ccsx_writer_close(void* h) {
+  Writer* w = (Writer*)h;
+  bool ok = w->close();
+  delete w;
+  return ok ? 0 : -1;
 }
 
 // ---- encode / reverse-complement (main.c:222-241, seqio.h:120-148) ------

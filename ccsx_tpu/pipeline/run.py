@@ -37,13 +37,44 @@ def open_zmw_stream(path: str, cfg: CcsConfig):
     from ccsx_tpu import native
 
     if path != "-" and native.available():
-        from ccsx_tpu.native.io import stream_zmws_native
+        from ccsx_tpu.native.io import stream_zmws_prefetch
 
-        return stream_zmws_native(path, cfg)
+        return stream_zmws_prefetch(path, cfg)
     f = sys.stdin.buffer if path == "-" else open(path, "rb")
     records = (bam_mod.read_bam_records(f) if cfg.is_bam
                else fastx.read_fastx(f))
     return zmw.stream_zmws(records, cfg)
+
+
+class _PyWriter:
+    """FASTA writer over a Python file object (stdout / fallback path)."""
+
+    def __init__(self, f, own: bool):
+        self._f = f
+        self._own = own
+
+    def put(self, name: str, seq: bytes) -> None:
+        self._f.write(f">{name}\n{seq.decode()}\n")
+
+    def close(self) -> None:
+        if self._own:
+            self._f.close()
+
+
+def open_writer(path: str, append: bool):
+    """Async native writer for real paths; Python writer for stdout.
+
+    stdout stays Python-level so redirection (tests, `ccsx-tpu ... -`) works.
+    """
+    from ccsx_tpu import native
+
+    if path != "-" and native.available():
+        from ccsx_tpu.native.io import NativeFastaWriter
+
+        return NativeFastaWriter(path, append=append)
+    if path == "-":
+        return _PyWriter(sys.stdout, own=False)
+    return _PyWriter(open(path, "a" if append else "w"), own=True)
 
 
 def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
@@ -55,9 +86,8 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
         return 1
     journal = Journal.load_or_create(journal_path, input_id=in_path)
     resume = journal.holes_done
-    mode = "a" if resume else "w"
     try:
-        out = sys.stdout if out_path == "-" else open(out_path, mode)
+        writer = open_writer(out_path, append=bool(resume))
     except OSError:
         print("Cannot open file for write!", file=sys.stderr)
         return 1
@@ -80,7 +110,7 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
             print(f"[ccsx-tpu] hole {z.movie}/{z.hole} failed: {err}",
                   file=sys.stderr)
         elif cns:
-            out.write(f">{z.movie}/{z.hole}/ccs\n{cns.decode()}\n")
+            writer.put(f"{z.movie}/{z.hole}/ccs", cns)
             metrics.holes_out += 1
         journal.advance()
 
@@ -109,10 +139,16 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
     except (bam_mod.BamError, zmw.InvalidZmwName, ValueError) as e:
         print(f"Error: invalid input stream: {e}", file=sys.stderr)
         rc = 1
+    except OSError as e:
+        print(f"Error: write failed: {e}", file=sys.stderr)
+        rc = 1
     finally:
         if pool is not None:
             pool.shutdown(wait=True)
-        if out is not sys.stdout:
-            out.close()
+        try:
+            writer.close()
+        except OSError:
+            print("Error: write failed!", file=sys.stderr)
+            rc = 1
         metrics.report()
     return rc

@@ -173,6 +173,61 @@ def test_zmw_invalid_name(tmp_path):
         list(stream_zmws_native(str(p), cfg))
 
 
+def test_prefetch_stream_parity(tmp_path):
+    cfg = CcsConfig(is_bam=False, min_subread_len=100, max_subread_len=10**6)
+    p = _mkfasta(tmp_path, [(f"m{i % 3}", str(i), [150 + i] * 6)
+                            for i in range(40)])
+    from ccsx_tpu.native.io import stream_zmws_native, stream_zmws_prefetch
+    got = list(stream_zmws_prefetch(str(p), cfg, queue_cap=4))
+    want = list(stream_zmws_native(str(p), cfg))
+    assert len(got) == len(want) == 40
+    for a, b in zip(got, want):
+        assert (a.movie, a.hole, a.seqs) == (b.movie, b.hole, b.seqs)
+        np.testing.assert_array_equal(a.lens, b.lens)
+
+
+def test_prefetch_error_propagates(tmp_path):
+    p = tmp_path / "bad.fa"
+    p.write_text(">m/1/0\nACGT\n>oops\nACGT\n")
+    from ccsx_tpu.native.io import stream_zmws_prefetch
+    cfg = CcsConfig(is_bam=False, min_subread_len=0)
+    with pytest.raises(zmw.InvalidZmwName):
+        list(stream_zmws_prefetch(str(p), cfg))
+
+
+def test_prefetch_early_close(tmp_path):
+    # dropping the iterator mid-stream must not hang the producer thread
+    cfg = CcsConfig(is_bam=False, min_subread_len=0)
+    p = _mkfasta(tmp_path, [("m", str(i), [200] * 6) for i in range(50)])
+    from ccsx_tpu.native.io import stream_zmws_prefetch
+    it = stream_zmws_prefetch(str(p), cfg, queue_cap=2)
+    next(it)
+    it.close()
+
+
+def test_native_writer(tmp_path):
+    from ccsx_tpu.native.io import NativeFastaWriter
+    p = tmp_path / "out.fa"
+    w = NativeFastaWriter(str(p))
+    for i in range(500):
+        w.put(f"m/{i}/ccs", b"ACGT" * (i % 7 + 1))
+    w.close()
+    lines = p.read_text().strip().split("\n")
+    assert len(lines) == 1000
+    assert [ln for ln in lines[0::2]] == [f">m/{i}/ccs" for i in range(500)]
+    # append mode
+    w = NativeFastaWriter(str(p), append=True)
+    w.put("m/extra/ccs", b"TTTT")
+    w.close()
+    assert p.read_text().strip().split("\n")[-2:] == [">m/extra/ccs", "TTTT"]
+
+
+def test_native_writer_bad_path():
+    from ccsx_tpu.native.io import NativeFastaWriter
+    with pytest.raises(OSError):
+        NativeFastaWriter("/nonexistent-dir/x/y.fa")
+
+
 def test_encode_revcomp_native():
     from ccsx_tpu.native.io import encode_native, revcomp_codes_native
     seq = b"ACGTNacgtnXYZ-"
