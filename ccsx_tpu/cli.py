@@ -44,8 +44,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--device", default="auto", choices=["auto", "tpu", "cpu"])
     p.add_argument("--refine-iters", type=int, default=2)
     p.add_argument("--max-passes", type=int, default=32)
-    p.add_argument("--batch", action="store_true",
-                   help="Use the batched device pipeline (default when TPU)")
+    p.add_argument("--batch", default="auto",
+                   choices=["auto", "on", "off"],
+                   help="Batched device pipeline: many holes per TPU "
+                        "dispatch [auto: on for TPU backends]")
+    p.add_argument("--inflight", type=int, default=None,
+                   help="Holes in flight in the batched pipeline "
+                        "[zmw_microbatch]")
     p.add_argument("--journal", default=None,
                    help="Progress journal path for resumable runs")
     return p
@@ -82,12 +87,22 @@ def main(argv: Optional[list] = None) -> int:
     except SystemExit as e:
         return int(e.code or 0)
 
-    if args.batch:
-        print("[ccsx-tpu] --batch: batched device pipeline not wired into "
-              "the CLI yet; running the per-hole path", file=sys.stderr)
-
     # imports deferred so --help stays fast and backend selection happens
-    # after the config is known
+    # after the config is known.  Resolve the backend FIRST (honoring
+    # --device cpu before any backend initializes) and decide --batch auto
+    # from the resolved backend.
+    from ccsx_tpu.utils.device import resolve_device
+
+    backend = resolve_device(cfg.device)
+    batch = args.batch
+    if batch == "auto":
+        batch = "on" if backend == "tpu" else "off"
+    if batch == "on":
+        from ccsx_tpu.pipeline.batch import run_pipeline_batched
+
+        return run_pipeline_batched(args.input, args.output, cfg,
+                                    journal_path=args.journal,
+                                    inflight=args.inflight)
     from ccsx_tpu.pipeline.run import run_pipeline
 
     return run_pipeline(args.input, args.output, cfg,

@@ -1,0 +1,37 @@
+"""Per-hole consensus entry points shared by the per-hole and batched
+pipelines: one function selects the consensus generator for a ZMW
+(windowed by default, whole-read star MSA under -P — main.c:701-704), so
+the two pipelines cannot drift apart in prep or mode selection.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ccsx_tpu.config import CcsConfig
+from ccsx_tpu.consensus import prepare as prep
+from ccsx_tpu.consensus.star import StarMsa, run_rounds
+from ccsx_tpu.consensus.windowed import windowed_gen
+from ccsx_tpu.ops import encode as enc
+
+
+def consensus_gen_for_zmw(zmw, aligner, cfg: CcsConfig):
+    """The consensus generator for one hole, or None if it is skipped."""
+    passes = prep.oriented_passes(zmw, aligner, cfg)
+    if passes is None:
+        return None
+    if cfg.split_subread:
+        return windowed_gen(passes, cfg)
+    sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
+    return sm.consensus_gen(
+        passes, cfg.refine_iters, cfg.pass_buckets, cfg.max_passes)
+
+
+def ccs_hole(zmw, aligner, cfg: CcsConfig) -> Optional[bytes]:
+    """Per-hole path: run the hole's generator with immediate rounds."""
+    gen = consensus_gen_for_zmw(zmw, aligner, cfg)
+    if gen is None:
+        return None
+    sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
+    codes = run_rounds(gen, sm)
+    return enc.decode(codes).encode()

@@ -204,6 +204,21 @@ def ccs_prepare(codes: np.ndarray, lens, offs, aligner,
     return segments
 
 
+def oriented_passes(zmw, aligner, cfg):
+    """Prep shared by every consensus path: encode, orient/clip, slice.
+
+    Returns the oriented pass code arrays (template pass first), or None
+    when the hole has <3 passes (main.c:460,515).
+    """
+    if zmw.n_passes < 3:
+        return None
+    from ccsx_tpu.ops import encode as enc
+
+    codes = enc.encode(zmw.seqs)
+    segments = ccs_prepare(codes, zmw.lens, zmw.offs, aligner, cfg)
+    return [oriented_pass(codes, s) for s in segments]
+
+
 def oriented_pass(codes: np.ndarray, seg: Segment) -> np.ndarray:
     """Extract a segment's bases, reverse-complemented when needed
     (the in-place RC at main.c:471-480, done functionally here)."""

@@ -88,6 +88,33 @@ def _voter(max_ins: int):
 
 
 @dataclasses.dataclass
+class RoundRequest:
+    """One star-MSA round of device work, requested by a consensus
+    generator (windowed.windowed_gen / StarMsa.consensus_gen).
+
+    The per-hole path satisfies these one at a time (run_rounds); the
+    batched pipeline (pipeline/batch.py) stacks requests of equal shape
+    from many holes into one (Z, P, W) device dispatch.
+    """
+
+    qs: np.ndarray        # (P, qmax) uint8 padded passes
+    qlens: np.ndarray     # (P,) int32
+    row_mask: np.ndarray  # (P,) bool
+    draft: np.ndarray     # (tlen,) uint8 codes — alignment target
+
+
+def run_rounds(gen, sm: "StarMsa"):
+    """Drive a consensus generator with immediate per-hole rounds."""
+    try:
+        req = next(gen)
+        while True:
+            rr = sm.round(req.qs, req.qlens, req.row_mask, req.draft)
+            req = gen.send(rr)
+    except StopIteration as e:
+        return e.value
+
+
+@dataclasses.dataclass
 class RoundResult:
     """Device arrays from one star-MSA round (draft coordinates)."""
 
@@ -158,13 +185,20 @@ class StarMsa:
             [len(p) for p in passes] + [0] * (P - len(passes)), np.int32)
         return qs, qlens, qlens > 0
 
+    def consensus_gen(self, passes: List[np.ndarray], iters: int,
+                      pass_buckets: Sequence[int], max_passes: int):
+        """Generator form of consensus(): yields RoundRequests, receives
+        RoundResults, returns the final draft via StopIteration.value."""
+        qs, qlens, row_mask = self.pack(passes, pass_buckets, max_passes)
+        draft = passes[0]
+        for it in range(iters + 1):
+            rr = yield RoundRequest(qs, qlens, row_mask, draft)
+            draft = rr.materialize(speculative=(it < iters))
+        return draft
+
     def consensus(self, passes: List[np.ndarray], iters: int,
                   pass_buckets: Sequence[int], max_passes: int) -> np.ndarray:
         """iters+1 rounds; intermediate rounds insert speculatively (see
         msa.emit_insertions), the final round applies strict majority."""
-        qs, qlens, row_mask = self.pack(passes, pass_buckets, max_passes)
-        draft = passes[0]
-        for it in range(iters + 1):
-            rr = self.round(qs, qlens, row_mask, draft)
-            draft = rr.materialize(speculative=(it < iters))
-        return draft
+        return run_rounds(
+            self.consensus_gen(passes, iters, pass_buckets, max_passes), self)

@@ -31,10 +31,8 @@ def consensus_passes(passes: List[np.ndarray], cfg: CcsConfig) -> np.ndarray:
 def ccs_whole_read(zmw, aligner, cfg: CcsConfig) -> Optional[bytes]:
     """Full `-P` path for one ZMW (ccs_for, main.c:455-508): prepare ->
     orient -> star-MSA consensus.  Returns ASCII consensus or None."""
-    if zmw.n_passes < 3:  # main.c:460
+    passes = prep.oriented_passes(zmw, aligner, cfg)
+    if passes is None:  # main.c:460
         return None
-    codes = enc.encode(zmw.seqs)
-    segments = prep.ccs_prepare(codes, zmw.lens, zmw.offs, aligner, cfg)
-    passes = [prep.oriented_pass(codes, s) for s in segments]
     cns = consensus_passes(passes, cfg)
     return enc.decode(cns).encode()

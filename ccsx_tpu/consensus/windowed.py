@@ -29,7 +29,9 @@ import numpy as np
 
 from ccsx_tpu.config import CcsConfig
 from ccsx_tpu.consensus import prepare as prep
-from ccsx_tpu.consensus.star import RoundResult, StarMsa
+from ccsx_tpu.consensus.star import (
+    RoundRequest, RoundResult, StarMsa, run_rounds,
+)
 from ccsx_tpu.ops import encode as enc
 
 
@@ -79,8 +81,9 @@ def _advance(rr: RoundResult, bp: int) -> np.ndarray:
     return (nongap + ins + rr.lead_ins).astype(np.int64)
 
 
-def consensus_windowed(passes: List[np.ndarray], cfg: CcsConfig) -> np.ndarray:
-    """Windowed consensus over oriented passes; passes[0] anchors."""
+def windowed_gen(passes: List[np.ndarray], cfg: CcsConfig):
+    """Generator form of consensus_windowed: yields RoundRequests, receives
+    RoundResults, returns the consensus codes via StopIteration.value."""
     sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
     if len(passes) > cfg.max_passes:
         passes = passes[: cfg.max_passes]
@@ -106,7 +109,7 @@ def consensus_windowed(passes: List[np.ndarray], cfg: CcsConfig) -> np.ndarray:
             draft = windows[0]
             rr = None
             for it in range(cfg.refine_iters + 1):
-                rr = sm.round(qs, qlens, row_mask, draft)
+                rr = yield RoundRequest(qs, qlens, row_mask, draft)
                 draft = rr.materialize(speculative=(it < cfg.refine_iters))
 
             if final:
@@ -129,13 +132,17 @@ def consensus_windowed(passes: List[np.ndarray], cfg: CcsConfig) -> np.ndarray:
     return np.concatenate(out) if out else np.zeros(0, np.uint8)
 
 
+def consensus_windowed(passes: List[np.ndarray], cfg: CcsConfig) -> np.ndarray:
+    """Windowed consensus over oriented passes; passes[0] anchors."""
+    sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
+    return run_rounds(windowed_gen(passes, cfg), sm)
+
+
 def ccs_windowed(zmw, aligner, cfg: CcsConfig) -> Optional[bytes]:
     """Full default path for one ZMW (ccs_for2): prepare -> orient ->
     windowed star consensus."""
-    if zmw.n_passes < 3:  # main.c:515
+    passes = prep.oriented_passes(zmw, aligner, cfg)
+    if passes is None:  # main.c:515
         return None
-    codes = enc.encode(zmw.seqs)
-    segments = prep.ccs_prepare(codes, zmw.lens, zmw.offs, aligner, cfg)
-    passes = [prep.oriented_pass(codes, s) for s in segments]
     cns = consensus_windowed(passes, cfg)
     return enc.decode(cns).encode()

@@ -1,0 +1,280 @@
+"""Batched device pipeline: many holes per TPU dispatch.
+
+The per-hole path (pipeline/run.py) dispatches one star-MSA round per hole
+per window — correct, but each dispatch is a small (P, W) problem that
+leaves the chip mostly idle.  This runner multiplexes the consensus
+generators (windowed_gen / consensus_gen) of many in-flight holes and
+executes their pending RoundRequests together:
+
+  admit holes ──> per-hole generator (host state machine)
+                    │ yields RoundRequest (P, qmax) + draft
+                    ▼
+  group by (P, qmax, tmax) shape bucket ──> stack to (Z, P, qmax)
+                    ▼
+  ONE jitted device round per group: banded DP fill (Pallas on TPU) +
+  traceback projection + column vote, vmapped over Z and P
+                    ▼
+  RoundResults routed back into each generator; finished holes emit
+  consensus to the order-preserving writer.
+
+This is the TPU analog of the reference's kt_for over a chunk's ZMWs
+(main.c:702-704): the chunk becomes a device batch, the work-stealing
+becomes shape-bucketed batching (SURVEY.md §2.2).  Output order is input
+order, like the reference's ordered pipeline (kthread.c:202-213).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import sys
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ccsx_tpu.config import AlignParams, CcsConfig
+from ccsx_tpu.consensus.align_host import HostAligner
+from ccsx_tpu.consensus.hole import consensus_gen_for_zmw
+from ccsx_tpu.consensus.star import (
+    RoundRequest, RoundResult, pad_to, quantize_len,
+)
+from ccsx_tpu.ops import encode as enc
+from ccsx_tpu.ops import traceback
+from ccsx_tpu.utils.journal import Journal
+from ccsx_tpu.utils.metrics import Metrics
+
+
+@functools.lru_cache(maxsize=128)
+def _round_step(params: AlignParams, max_ins: int, tmax: int):
+    """Jitted batched star round: (Z, P, qmax) passes vs (Z, tmax) drafts.
+
+    Z/P/qmax shape specialization is left to jit's trace cache; tmax and
+    max_ins fix the projector's output shape so they key the cache here.
+    """
+    from ccsx_tpu.consensus import star as star_mod
+    from ccsx_tpu.ops import msa as msa_mod
+
+    aligner = star_mod._aligner(params)  # Pallas on TPU, scan otherwise
+    projector = traceback.make_projector(tmax, max_ins)
+    voter = msa_mod.make_voter(max_ins)
+
+    @jax.jit
+    def step(qs, qlens, ts, tlens, row_mask):
+        Z, P, qmax = qs.shape
+        ts_b = jax.numpy.broadcast_to(ts[:, None, :], (Z, P, tmax))
+        tl_b = jax.numpy.broadcast_to(tlens[:, None], (Z, P))
+        _, moves, offs = aligner(
+            qs.reshape(Z * P, qmax), qlens.reshape(Z * P),
+            ts_b.reshape(Z * P, tmax), tl_b.reshape(Z * P))
+        moves = moves.reshape(Z, P, qmax, -1)
+        offs = offs.reshape(Z, P, qmax)
+        proj = jax.vmap(jax.vmap(projector, in_axes=(0, 0, 0, 0, None)),
+                        in_axes=(0, 0, 0, 0, 0))
+        aligned, ins_cnt, ins_b, lead_ins = proj(moves, offs, qs, qlens, tlens)
+        cons, ins_base, ins_votes, ncov, match = jax.vmap(voter)(
+            aligned, ins_cnt, ins_b, row_mask)
+        return cons, ins_base, ins_votes, ncov, match, aligned, ins_cnt, lead_ins
+
+    return step
+
+
+def _z_bucket(n: int) -> int:
+    """Pad the batch Z to the next power of two (bounds jit retraces)."""
+    z = 1
+    while z < n:
+        z *= 2
+    return z
+
+
+class BatchExecutor:
+    """Groups RoundRequests by shape and runs one device round per group."""
+
+    def __init__(self, cfg: CcsConfig):
+        self.cfg = cfg
+        self.len_quant = cfg.len_bucket_quant
+
+    def run(self, requests: List[RoundRequest]) -> List[RoundResult]:
+        """Satisfy all requests; results align index-for-index."""
+        cfg = self.cfg
+        groups: Dict[tuple, List[int]] = defaultdict(list)
+        for i, req in enumerate(requests):
+            P, qmax = req.qs.shape
+            tmax = quantize_len(len(req.draft), self.len_quant)
+            groups[(P, qmax, tmax)].append(i)
+
+        results: List[Optional[RoundResult]] = [None] * len(requests)
+        for (P, qmax, tmax), idxs in groups.items():
+            n = len(idxs)
+            Z = _z_bucket(n)
+            qs = np.zeros((Z, P, qmax), np.uint8)
+            qlens = np.zeros((Z, P), np.int32)
+            ts = np.zeros((Z, tmax), np.uint8)
+            tlens = np.ones((Z,), np.int32)  # pad holes: 1-col no-op drafts
+            row_mask = np.zeros((Z, P), bool)
+            for z, i in enumerate(idxs):
+                req = requests[i]
+                qs[z] = req.qs
+                qlens[z] = req.qlens
+                ts[z] = pad_to(req.draft, tmax)
+                tlens[z] = len(req.draft)
+                row_mask[z] = req.row_mask
+            step = _round_step(cfg.align, cfg.max_ins_per_col, tmax)
+            out = step(qs, qlens, ts, tlens, row_mask)
+            (cons, ins_base, ins_votes, ncov, match,
+             aligned, ins_cnt, lead_ins) = (np.asarray(o) for o in out)
+            for z, i in enumerate(idxs):
+                results[i] = RoundResult(
+                    cons=cons[z], ins_base=ins_base[z],
+                    ins_votes=ins_votes[z], ncov=ncov[z], match=match[z],
+                    aligned=aligned[z], ins_cnt=ins_cnt[z],
+                    lead_ins=lead_ins[z], tlen=len(requests[i].draft),
+                )
+        return results
+
+
+@dataclasses.dataclass
+class _Hole:
+    idx: int
+    zmw: object
+    gen: object = None         # consensus generator (None => skipped)
+    req: RoundRequest = None   # pending device work
+    done: bool = False
+    resumed: bool = False      # written by a previous run; skip + no journal
+    cns: Optional[bytes] = None
+    err: Optional[Exception] = None
+
+
+def _start_hole(hole: _Hole, aligner: HostAligner, cfg: CcsConfig) -> None:
+    """Host prep (orientation + clip) and first generator step."""
+    try:
+        hole.gen = consensus_gen_for_zmw(hole.zmw, aligner, cfg)
+        if hole.gen is None:  # main.c:515
+            hole.done = True
+            return
+        hole.req = next(hole.gen)
+    except StopIteration as e:
+        hole.done, hole.cns = True, _finish(e.value)
+    except Exception as e:  # quarantine: one bad hole must not kill the run
+        hole.done, hole.err = True, e
+
+
+def _advance_hole(hole: _Hole, rr: RoundResult) -> None:
+    try:
+        hole.req = hole.gen.send(rr)
+    except StopIteration as e:
+        hole.done, hole.req, hole.cns = True, None, _finish(e.value)
+    except Exception as e:
+        hole.done, hole.req, hole.err = True, None, e
+
+
+def _finish(codes: np.ndarray) -> Optional[bytes]:
+    return enc.decode(codes).encode() if codes is not None else None
+
+
+def run_pipeline_batched(in_path: str, out_path: str, cfg: CcsConfig,
+                         journal_path: Optional[str] = None,
+                         inflight: Optional[int] = None) -> int:
+    """Batched end-to-end driver (CLI --batch; default on TPU backends)."""
+    from ccsx_tpu.io import bam as bam_mod
+    from ccsx_tpu.io import zmw as zmw_mod
+    from ccsx_tpu.pipeline.run import open_writer, open_zmw_stream
+    from ccsx_tpu.utils.device import resolve_device
+
+    try:
+        stream = open_zmw_stream(in_path, cfg)
+    except (OSError, RuntimeError) as e:
+        print(f"Error: Failed to open infile! ({e})", file=sys.stderr)
+        return 1
+    journal = Journal.load_or_create(journal_path, input_id=in_path)
+    resume = journal.holes_done
+    try:
+        writer = open_writer(out_path, append=bool(resume))
+    except OSError:
+        print("Cannot open file for write!", file=sys.stderr)
+        return 1
+
+    resolve_device(cfg.device)
+    aligner = HostAligner(cfg.align)
+    metrics = Metrics(verbose=cfg.verbose)
+    executor = BatchExecutor(cfg)
+    inflight = inflight or cfg.zmw_microbatch
+
+    active: List[_Hole] = []
+    finished: Dict[int, _Hole] = {}
+    next_idx = 0       # next hole index to admit
+    next_emit = 0      # next hole index to write
+    exhausted = False
+    rc = 0
+
+    def emit_ready():
+        nonlocal next_emit
+        while next_emit in finished:
+            h = finished.pop(next_emit)
+            if h.resumed:
+                next_emit += 1
+                continue
+            if h.err is not None:
+                metrics.holes_failed += 1
+                print(f"[ccsx-tpu] hole {h.zmw.movie}/{h.zmw.hole} "
+                      f"failed: {h.err}", file=sys.stderr)
+            elif h.cns:
+                writer.put(f"{h.zmw.movie}/{h.zmw.hole}/ccs", h.cns)
+                metrics.holes_out += 1
+            journal.advance()
+            next_emit += 1
+
+    try:
+        while True:
+            # admit up to the in-flight window; bound TOTAL outstanding
+            # holes (incl. instantly-finished ones parked for ordered
+            # emission) so a filtered run can't grow memory unboundedly
+            while (not exhausted and len(active) < inflight
+                   and next_idx - next_emit < 4 * inflight):
+                try:
+                    z = next(stream)
+                except StopIteration:
+                    exhausted = True
+                    break
+                metrics.holes_in += 1
+                h = _Hole(idx=next_idx, zmw=z)
+                next_idx += 1
+                if metrics.holes_in <= resume:
+                    h.done = h.resumed = True
+                else:
+                    _start_hole(h, aligner, cfg)
+                if h.done:
+                    finished[h.idx] = h
+                else:
+                    active.append(h)
+            emit_ready()
+            if not active:
+                if exhausted:
+                    break
+                continue
+            # one batched device round over every pending request
+            reqs = [h.req for h in active]
+            still: List[_Hole] = []
+            for h, rr in zip(active, executor.run(reqs)):
+                _advance_hole(h, rr)
+                if h.done:
+                    finished[h.idx] = h
+                else:
+                    still.append(h)
+            active = still
+            emit_ready()
+    except (bam_mod.BamError, zmw_mod.InvalidZmwName, ValueError) as e:
+        print(f"Error: invalid input stream: {e}", file=sys.stderr)
+        rc = 1
+    except OSError as e:
+        print(f"Error: write failed: {e}", file=sys.stderr)
+        rc = 1
+    finally:
+        try:
+            writer.close()
+        except OSError:
+            print("Error: write failed!", file=sys.stderr)
+            rc = 1
+        metrics.report()
+    return rc

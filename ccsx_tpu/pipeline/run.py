@@ -16,8 +16,7 @@ from typing import Optional
 
 from ccsx_tpu.config import CcsConfig
 from ccsx_tpu.consensus.align_host import HostAligner
-from ccsx_tpu.consensus.whole_read import ccs_whole_read
-from ccsx_tpu.consensus.windowed import ccs_windowed
+from ccsx_tpu.consensus.hole import ccs_hole
 from ccsx_tpu.io import bam as bam_mod
 from ccsx_tpu.io import fastx, zmw
 from ccsx_tpu.utils.device import resolve_device
@@ -95,11 +94,10 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
     resolve_device(cfg.device)
     aligner = HostAligner(cfg.align)
     metrics = Metrics(verbose=cfg.verbose)
-    ccs_fn = ccs_windowed if cfg.split_subread else ccs_whole_read
 
     def compute(z):
         try:
-            return z, ccs_fn(z, aligner, cfg), None
+            return z, ccs_hole(z, aligner, cfg), None
         except Exception as e:  # quarantine: one bad hole must not kill the run
             return z, None, e
 

@@ -1,0 +1,135 @@
+"""Batched device pipeline (pipeline/batch.py): parity with the per-hole
+path, shape-bucketed execution, ordering, quarantine, and resume."""
+
+import numpy as np
+import pytest
+
+from ccsx_tpu import cli
+from ccsx_tpu.config import CcsConfig
+from ccsx_tpu.consensus.star import RoundRequest, StarMsa, run_rounds
+from ccsx_tpu.consensus.windowed import windowed_gen
+from ccsx_tpu.io import fastx
+from ccsx_tpu.ops import encode as enc
+from ccsx_tpu.pipeline.batch import BatchExecutor, _z_bucket
+from ccsx_tpu.utils import synth
+
+
+def _passes(rng, n=4, tlen=600):
+    tpl = rng.integers(0, 4, tlen).astype(np.uint8)
+    return [synth.mutate(rng, tpl, 0.02, 0.04, 0.04) for _ in range(n)]
+
+
+def test_z_bucket():
+    assert _z_bucket(1) == 1
+    assert _z_bucket(3) == 4
+    assert _z_bucket(64) == 64
+    assert _z_bucket(65) == 128  # keeps doubling: bounded retraces
+
+
+def test_executor_matches_per_hole_rounds(rng):
+    """One batched dispatch == N independent per-hole rounds, bitwise."""
+    cfg = CcsConfig(is_bam=False)
+    sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
+    reqs = []
+    for i in range(5):
+        ps = _passes(rng, n=3 + (i % 3), tlen=500 + 40 * i)
+        qs, qlens, row_mask = sm.pack(ps, cfg.pass_buckets, cfg.max_passes)
+        reqs.append(RoundRequest(qs, qlens, row_mask, ps[0]))
+
+    batched = BatchExecutor(cfg).run(reqs)
+    for req, rb in zip(reqs, batched):
+        ra = sm.round(req.qs, req.qlens, req.row_mask, req.draft)
+        assert ra.tlen == rb.tlen
+        np.testing.assert_array_equal(ra.cons, rb.cons)
+        np.testing.assert_array_equal(ra.aligned, rb.aligned)
+        np.testing.assert_array_equal(ra.ins_cnt, rb.ins_cnt)
+        np.testing.assert_array_equal(ra.ins_base, rb.ins_base)
+        np.testing.assert_array_equal(ra.ins_votes, rb.ins_votes)
+        np.testing.assert_array_equal(ra.match, rb.match)
+        np.testing.assert_array_equal(ra.lead_ins, rb.lead_ins)
+
+
+def test_executor_drives_windowed_gen_to_same_result(rng):
+    """Driving the windowed generator with batched results reproduces the
+    per-hole windowed consensus exactly."""
+    cfg = CcsConfig(is_bam=False, window_init=512, window_add=512,
+                    window_minlen=256, max_window=2048)
+    sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
+    ps = _passes(rng, n=5, tlen=1500)
+
+    want = run_rounds(windowed_gen(ps, cfg), sm)
+
+    ex = BatchExecutor(cfg)
+    gen = windowed_gen(ps, cfg)
+    req = next(gen)
+    try:
+        while True:
+            rr = ex.run([req])[0]
+            req = gen.send(rr)
+    except StopIteration as e:
+        got = e.value
+    np.testing.assert_array_equal(want, got)
+
+
+def _make_inputs(tmp_path, rng, n_holes, tlen=900):
+    # >=5 passes so every hole clears the count filter (min_fulllen_count+2)
+    zs = [synth.make_zmw(rng, template_len=tlen, n_passes=5 + (h % 3),
+                         movie="mv", hole=str(100 + h))
+          for h in range(n_holes)]
+    fa = tmp_path / "in.fa"
+    fa.write_text(synth.make_fasta(zs))
+    return zs, fa
+
+
+def test_cli_batched_equals_per_hole(tmp_path, rng):
+    """--batch on must produce byte-identical output to --batch off."""
+    zs, fa = _make_inputs(tmp_path, rng, n_holes=4)
+    o_ref = tmp_path / "ref.fa"
+    o_bat = tmp_path / "bat.fa"
+    assert cli.main(["-A", "-m", "1000", "--batch", "off",
+                     str(fa), str(o_ref)]) == 0
+    assert cli.main(["-A", "-m", "1000", "--batch", "on",
+                     str(fa), str(o_bat)]) == 0
+    assert o_ref.read_text() == o_bat.read_text()
+    assert o_ref.read_text().count(">") == 4
+
+
+def test_cli_batched_whole_read_equals_per_hole(tmp_path, rng):
+    zs, fa = _make_inputs(tmp_path, rng, n_holes=3)
+    o_ref = tmp_path / "ref.fa"
+    o_bat = tmp_path / "bat.fa"
+    assert cli.main(["-A", "-P", "-m", "1000", "--batch", "off",
+                     str(fa), str(o_ref)]) == 0
+    assert cli.main(["-A", "-P", "-m", "1000", "--batch", "on",
+                     str(fa), str(o_bat)]) == 0
+    assert o_ref.read_text() == o_bat.read_text()
+
+
+def test_cli_batched_small_inflight_preserves_order(tmp_path, rng):
+    """A tiny in-flight window forces staggered admission; output order
+    must stay input order."""
+    zs, fa = _make_inputs(tmp_path, rng, n_holes=5, tlen=700)
+    out = tmp_path / "o.fa"
+    assert cli.main(["-A", "-m", "1000", "--batch", "on",
+                     "--inflight", "2", str(fa), str(out)]) == 0
+    names = [r.name for r in fastx.read_fastx(str(out))]
+    assert names == [f"mv/{100 + h}/ccs" for h in range(5)]
+
+
+def test_cli_batched_journal_resume(tmp_path, rng):
+    import json
+
+    zs, fa = _make_inputs(tmp_path, rng, n_holes=3, tlen=700)
+    full = tmp_path / "full.fa"
+    assert cli.main(["-A", "-m", "1000", "--batch", "on",
+                     str(fa), str(full)]) == 0
+    out = tmp_path / "o.fa"
+    jp = tmp_path / "j.json"
+    jp.write_text(json.dumps({"input_id": str(fa), "holes_done": 2}))
+    recs = list(fastx.read_fastx(str(full)))
+    out.write_text("".join(f">{r.name}\n{r.seq.decode()}\n"
+                           for r in recs[:2]))
+    assert cli.main(["-A", "-m", "1000", "--batch", "on",
+                     "--journal", str(jp), str(fa), str(out)]) == 0
+    assert out.read_text() == full.read_text()
+    assert json.loads(jp.read_text())["holes_done"] == 3
