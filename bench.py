@@ -33,23 +33,27 @@ def measure():
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from ccsx_tpu.config import AlignParams
-    from ccsx_tpu.ops import banded, msa, traceback
+    from ccsx_tpu.consensus import star
+    from ccsx_tpu.ops import msa, traceback
     import __graft_entry__ as ge
 
     params = AlignParams()
     projector = traceback.make_projector(W, 4)
     voter = msa.make_voter(4)
-
-    import functools
-
-    align_one = functools.partial(
-        banded.banded_align, mode="global", params=params, with_moves=True)
+    # the production aligner dispatch: Pallas DP-fill kernel on TPU
+    # backends, the lax.scan spec elsewhere (consensus/star.py)
+    aligner = star._aligner(params)
 
     @jax.jit
     def step(qs, qlens, ts, tlens, row_mask):
-        f = jax.vmap(jax.vmap(align_one, in_axes=(0, 0, None, None)),
-                     in_axes=(0, 0, 0, 0))
-        _, moves, offs = f(qs, qlens, ts, tlens)
+        Zb, Pb, qmax = qs.shape
+        ts_b = jax.numpy.broadcast_to(ts[:, None, :], (Zb, Pb, ts.shape[-1]))
+        tl_b = jax.numpy.broadcast_to(tlens[:, None], (Zb, Pb))
+        _, moves, offs = aligner(
+            qs.reshape(Zb * Pb, qmax), qlens.reshape(Zb * Pb),
+            ts_b.reshape(Zb * Pb, -1), tl_b.reshape(Zb * Pb))
+        moves = moves.reshape(Zb, Pb, qmax, -1)
+        offs = offs.reshape(Zb, Pb, qmax)
         proj = jax.vmap(jax.vmap(projector, in_axes=(0, 0, 0, 0, None)),
                         in_axes=(0, 0, 0, 0, 0))
         aligned, ins_cnt, ins_b, _lead = proj(moves, offs, qs, qlens, tlens)
