@@ -53,6 +53,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "[zmw_microbatch]")
     p.add_argument("--journal", default=None,
                    help="Progress journal path for resumable runs")
+    p.add_argument("--metrics", default=None,
+                   help="Append JSON-lines metrics events to this path")
+    p.add_argument("--profile", default=None,
+                   help="Write a jax.profiler trace to this directory")
+    # multi-host (parallel/distributed.py): run one process per host with
+    # --hosts N --host-id R, then merge with --merge-shards N
+    p.add_argument("--hosts", type=int, default=None,
+                   help="Total hosts in a sharded run")
+    p.add_argument("--host-id", type=int, default=None,
+                   help="This host's rank in [0, --hosts)")
+    p.add_argument("--coordinator", default=None,
+                   help="jax.distributed coordinator address host:port "
+                        "(optional; enables cross-host collectives)")
+    p.add_argument("--merge-shards", type=int, default=None, metavar="N",
+                   help="Merge OUTPUT.shard0..N-1 into OUTPUT and exit")
     return p
 
 
@@ -77,6 +92,7 @@ def config_from_args(args) -> CcsConfig:
         refine_iters=args.refine_iters,
         max_passes=args.max_passes,
         device=args.device,
+        metrics_path=args.metrics,
     )
 
 
@@ -88,25 +104,58 @@ def main(argv: Optional[list] = None) -> int:
         return int(e.code or 0)
 
     # imports deferred so --help stays fast and backend selection happens
-    # after the config is known.  Resolve the backend FIRST (honoring
-    # --device cpu before any backend initializes) and decide --batch auto
-    # from the resolved backend.
+    # after the config is known
+    if args.merge_shards is not None:
+        from ccsx_tpu.parallel.distributed import merge_shards
+
+        n = merge_shards(args.output, args.merge_shards)
+        print(f"[ccsx-tpu] merged {n} records from {args.merge_shards} "
+              "shards", file=sys.stderr)
+        return 0
+
+    sharded = args.hosts is not None and args.hosts > 1
+    if sharded:
+        if args.host_id is None:
+            print("Error: --hosts requires --host-id", file=sys.stderr)
+            return 1
+        if args.coordinator is not None:
+            from ccsx_tpu.parallel.distributed import init_distributed
+
+            init_distributed(args.coordinator, args.hosts, args.host_id)
+
+    # Resolve the backend FIRST (honoring --device cpu before any backend
+    # initializes) and decide --batch auto from the resolved backend.
     from ccsx_tpu.utils.device import resolve_device
 
     backend = resolve_device(cfg.device)
     batch = args.batch
     if batch == "auto":
         batch = "on" if backend == "tpu" else "off"
-    if batch == "on":
-        from ccsx_tpu.pipeline.batch import run_pipeline_batched
 
-        return run_pipeline_batched(args.input, args.output, cfg,
-                                    journal_path=args.journal,
-                                    inflight=args.inflight)
-    from ccsx_tpu.pipeline.run import run_pipeline
+    def _run():
+        if sharded:
+            from ccsx_tpu.parallel.distributed import run_pipeline_sharded
 
-    return run_pipeline(args.input, args.output, cfg,
-                        journal_path=args.journal)
+            return run_pipeline_sharded(
+                args.input, args.output, cfg, args.host_id, args.hosts,
+                journal_path=args.journal, inflight=args.inflight)
+        if batch == "on":
+            from ccsx_tpu.pipeline.batch import run_pipeline_batched
+
+            return run_pipeline_batched(args.input, args.output, cfg,
+                                        journal_path=args.journal,
+                                        inflight=args.inflight)
+        from ccsx_tpu.pipeline.run import run_pipeline
+
+        return run_pipeline(args.input, args.output, cfg,
+                            journal_path=args.journal)
+
+    if args.profile:
+        import jax
+
+        with jax.profiler.trace(args.profile):
+            return _run()
+    return _run()
 
 
 if __name__ == "__main__":

@@ -85,6 +85,12 @@ class CcsConfig:
     device: str = "auto"               # {auto, tpu, cpu}
     mesh_shape: Optional[tuple] = None  # e.g. (8,) data; None = all local devices
 
+    # ---- observability (SURVEY.md §5.1/5.5: absent in the reference) ----
+    metrics_path: Optional[str] = None  # JSON-lines metrics events
+
+    def metrics_stream(self):
+        return open(self.metrics_path, "a") if self.metrics_path else None
+
     def __post_init__(self):
         if self.min_fulllen_count < 3:
             raise ValueError(

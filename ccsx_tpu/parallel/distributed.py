@@ -1,0 +1,156 @@
+"""Multi-host distribution (SURVEY.md §5.8).
+
+The reference is strictly single-host (no MPI/NCCL/sockets anywhere in the
+repo; its "communication backend" is pthread mutex/condvar + atomics,
+kthread.c:30-223).  The TPU framework scales across hosts the JAX way:
+
+  * control plane — ``jax.distributed.initialize`` over DCN (one process
+    per host); collectives inside jitted steps ride ICI within a slice via
+    the mesh in parallel/mesh.py.
+  * input sharding — every host reads the same input stream and owns the
+    holes with ``global_index % num_processes == process_index``
+    (round-robin over the *filtered* hole stream, so the assignment is a
+    pure function of the input and needs no coordination).  ZMWs are
+    independent, so the hot path has zero cross-host traffic.
+  * output — each host writes ``<out>.shard<r>`` plus a sidecar index of
+    the global hole ordinal per record; ``merge_shards`` restores the
+    reference's input-ordered single FASTA exactly (kthread.c:202-213
+    ordering invariant, across hosts).
+
+The round-robin-over-one-stream design trades redundant parsing (every
+host decodes the full input) for zero coordination; with the native C++
+reader parsing is far faster than consensus, so this is the right trade
+until per-host byte-range BAM splitting (BGZF chunking) is worth it.
+"""
+
+from __future__ import annotations
+
+import heapq
+import os
+import sys
+from typing import Iterator, Optional
+
+from ccsx_tpu.config import CcsConfig
+from ccsx_tpu.utils.journal import Journal
+from ccsx_tpu.utils.metrics import Metrics
+
+
+def init_distributed(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> tuple:
+    """Initialize JAX's distributed runtime; returns (process_id, n).
+
+    With no arguments, relies on the environment (TPU pod metadata or
+    JAX_* env vars).  Safe to call once per process before any backend
+    use.  Single-process callers should not call this at all.
+    """
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return jax.process_index(), jax.process_count()
+
+
+def shard_stream(stream, rank: int, n: int) -> Iterator:
+    """Round-robin hole ownership: yields this rank's holes (the local
+    ordinal k maps to global ordinal rank + k*n)."""
+    for i, z in enumerate(stream):
+        if i % n == rank:
+            yield z
+
+
+def shard_path(out_path: str, rank: int) -> str:
+    return f"{out_path}.shard{rank}"
+
+
+class ShardWriter:
+    """FASTA shard + sidecar of global hole ordinals, for exact merge.
+
+    Local hole ordinal k (what drive_batched passes to put_at) maps to
+    global ordinal rank + k*n under round-robin sharding.
+    """
+
+    def __init__(self, out_path: str, rank: int, n: int, append: bool):
+        self.rank, self.n = rank, n
+        mode = "a" if append else "w"
+        self.path = shard_path(out_path, rank)
+        self._f = open(self.path, mode)
+        self._idx = open(self.path + ".idx", mode)
+
+    def put_at(self, local_idx: int, name: str, seq: bytes) -> None:
+        self._f.write(f">{name}\n{seq.decode()}\n")
+        self._idx.write(f"{self.rank + local_idx * self.n}\n")
+
+    def put(self, name: str, seq: bytes) -> None:  # pragma: no cover
+        raise RuntimeError("ShardWriter requires put_at")
+
+    def close(self) -> None:
+        self._f.close()
+        self._idx.close()
+
+
+def run_pipeline_sharded(in_path: str, out_path: str, cfg: CcsConfig,
+                         rank: int, n: int,
+                         journal_path: Optional[str] = None,
+                         inflight: Optional[int] = None) -> int:
+    """One host's share of a distributed run.
+
+    Writes <out>.shard<rank> (+ .idx).  After all ranks finish, any one
+    process calls merge_shards(out_path, n) to produce the final FASTA.
+    """
+    from ccsx_tpu.pipeline.batch import drive_batched
+    from ccsx_tpu.pipeline.run import open_zmw_stream
+    from ccsx_tpu.utils.device import resolve_device
+
+    if not (0 <= rank < n):
+        raise ValueError(f"rank {rank} outside [0, {n})")
+    try:
+        stream = open_zmw_stream(in_path, cfg)
+    except (OSError, RuntimeError) as e:
+        print(f"Error: Failed to open infile! ({e})", file=sys.stderr)
+        return 1
+    jp = f"{journal_path}.shard{rank}" if journal_path else None
+    journal = Journal.load_or_create(jp, input_id=f"{in_path}#{rank}/{n}")
+    try:
+        writer = ShardWriter(out_path, rank, n,
+                             append=bool(journal.holes_done))
+    except OSError:
+        print("Cannot open file for write!", file=sys.stderr)
+        return 1
+
+    resolve_device(cfg.device)
+    metrics = Metrics(verbose=cfg.verbose, stream=cfg.metrics_stream())
+    return drive_batched(shard_stream(stream, rank, n), writer, cfg,
+                         journal, metrics, inflight or cfg.zmw_microbatch)
+
+
+def merge_shards(out_path: str, n: int, cleanup: bool = True) -> int:
+    """K-way merge of <out>.shard0..n-1 by global hole ordinal into
+    out_path; returns the record count.  Restores exactly the single-host
+    output order."""
+
+    def records(rank: int):
+        p = shard_path(out_path, rank)
+        with open(p) as f, open(p + ".idx") as fi:
+            while True:
+                header = f.readline()
+                if not header:
+                    return
+                seq = f.readline()
+                idx = int(fi.readline())
+                yield idx, header + seq
+
+    count = 0
+    with open(out_path, "w") as out:
+        for _, rec in heapq.merge(*[records(r) for r in range(n)]):
+            out.write(rec)
+            count += 1
+    if cleanup:
+        for r in range(n):
+            p = shard_path(out_path, r)
+            os.unlink(p)
+            os.unlink(p + ".idx")
+    return count

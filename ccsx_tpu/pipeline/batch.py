@@ -173,33 +173,23 @@ def _finish(codes: np.ndarray) -> Optional[bytes]:
     return enc.decode(codes).encode() if codes is not None else None
 
 
-def run_pipeline_batched(in_path: str, out_path: str, cfg: CcsConfig,
-                         journal_path: Optional[str] = None,
-                         inflight: Optional[int] = None) -> int:
-    """Batched end-to-end driver (CLI --batch; default on TPU backends)."""
+def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
+                  metrics: Metrics, inflight: int) -> int:
+    """The batched scheduler loop over an open ZMW stream and writer.
+
+    Shared by the single-process driver (run_pipeline_batched) and the
+    multi-host sharded driver (parallel/distributed.py).  If the writer
+    exposes ``put_at(idx, name, seq)`` it receives each record's hole
+    ordinal too (the distributed shard writer needs it to restore global
+    order at merge time).
+    """
     from ccsx_tpu.io import bam as bam_mod
     from ccsx_tpu.io import zmw as zmw_mod
-    from ccsx_tpu.pipeline.run import open_writer, open_zmw_stream
-    from ccsx_tpu.utils.device import resolve_device
 
-    try:
-        stream = open_zmw_stream(in_path, cfg)
-    except (OSError, RuntimeError) as e:
-        print(f"Error: Failed to open infile! ({e})", file=sys.stderr)
-        return 1
-    journal = Journal.load_or_create(journal_path, input_id=in_path)
-    resume = journal.holes_done
-    try:
-        writer = open_writer(out_path, append=bool(resume))
-    except OSError:
-        print("Cannot open file for write!", file=sys.stderr)
-        return 1
-
-    resolve_device(cfg.device)
     aligner = HostAligner(cfg.align)
-    metrics = Metrics(verbose=cfg.verbose)
     executor = BatchExecutor(cfg)
-    inflight = inflight or cfg.zmw_microbatch
+    resume = journal.holes_done
+    put_at = getattr(writer, "put_at", None)
 
     active: List[_Hole] = []
     finished: Dict[int, _Hole] = {}
@@ -220,7 +210,11 @@ def run_pipeline_batched(in_path: str, out_path: str, cfg: CcsConfig,
                 print(f"[ccsx-tpu] hole {h.zmw.movie}/{h.zmw.hole} "
                       f"failed: {h.err}", file=sys.stderr)
             elif h.cns:
-                writer.put(f"{h.zmw.movie}/{h.zmw.hole}/ccs", h.cns)
+                name = f"{h.zmw.movie}/{h.zmw.hole}/ccs"
+                if put_at is not None:
+                    put_at(h.idx, name, h.cns)
+                else:
+                    writer.put(name, h.cns)
                 metrics.holes_out += 1
             journal.advance()
             next_emit += 1
@@ -278,3 +272,28 @@ def run_pipeline_batched(in_path: str, out_path: str, cfg: CcsConfig,
             rc = 1
         metrics.report()
     return rc
+
+
+def run_pipeline_batched(in_path: str, out_path: str, cfg: CcsConfig,
+                         journal_path: Optional[str] = None,
+                         inflight: Optional[int] = None) -> int:
+    """Batched end-to-end driver (CLI --batch; default on TPU backends)."""
+    from ccsx_tpu.pipeline.run import open_writer, open_zmw_stream
+    from ccsx_tpu.utils.device import resolve_device
+
+    try:
+        stream = open_zmw_stream(in_path, cfg)
+    except (OSError, RuntimeError) as e:
+        print(f"Error: Failed to open infile! ({e})", file=sys.stderr)
+        return 1
+    journal = Journal.load_or_create(journal_path, input_id=in_path)
+    try:
+        writer = open_writer(out_path, append=bool(journal.holes_done))
+    except OSError:
+        print("Cannot open file for write!", file=sys.stderr)
+        return 1
+
+    resolve_device(cfg.device)
+    metrics = Metrics(verbose=cfg.verbose, stream=cfg.metrics_stream())
+    return drive_batched(stream, writer, cfg, journal, metrics,
+                         inflight or cfg.zmw_microbatch)

@@ -93,7 +93,7 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
 
     resolve_device(cfg.device)
     aligner = HostAligner(cfg.align)
-    metrics = Metrics(verbose=cfg.verbose)
+    metrics = Metrics(verbose=cfg.verbose, stream=cfg.metrics_stream())
 
     def compute(z):
         try:
