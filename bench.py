@@ -73,12 +73,19 @@ def measure():
 
 def main():
     calibrate = "--calibrate" in sys.argv
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     if calibrate:
         # the baseline is the single-core XLA-CPU run of this workload;
         # the axon plugin overrides JAX_PLATFORMS, so force via config
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # the tunnelled TPU can hang on init; probe out-of-process and
+        # fall back to CPU so the bench always produces its JSON line
+        from ccsx_tpu.utils.device import resolve_device
+
+        resolve_device("auto")
     value = measure()
 
     baseline = None

@@ -8,7 +8,60 @@ not surface as a per-hole error storm in the quarantine path.
 
 from __future__ import annotations
 
+import os
 import sys
+
+
+def enable_compile_cache(path: str | None = None) -> str | None:
+    """Persistent XLA compilation cache (on by default).
+
+    Batched-round shapes recur across runs ((Z, P, qmax, tmax) buckets),
+    and a TPU compile costs 10-40s — without this cache every CLI
+    invocation repays the full compile bill.  CCSX_COMPILE_CACHE=off
+    disables; any other value overrides the default directory.
+    """
+    import jax
+
+    env = os.environ.get("CCSX_COMPILE_CACHE", "")
+    if env.lower() == "off":
+        return None
+    cache = path or env or os.path.expanduser("~/.cache/ccsx_tpu/xla")
+    try:
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except (OSError, AttributeError) as e:  # unwritable dir / old jax
+        print(f"[ccsx-tpu] compile cache disabled ({e})", file=sys.stderr)
+        return None
+    return cache
+
+
+def probe_default_backend(timeout: float = 90.0) -> bool:
+    """True if the default JAX backend initializes in a fresh subprocess.
+
+    The tunnelled TPU plugin can HANG on device init (not just fail), and
+    an in-process hang cannot be timed out — so the probe runs out of
+    process.  Skipped (returns True) when CCSX_SKIP_PROBE is set.
+    """
+    import subprocess
+
+    global _probe_result
+    if os.environ.get("CCSX_SKIP_PROBE"):
+        return True
+    if _probe_result is not None:
+        return _probe_result
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout, capture_output=True,
+        )
+        _probe_result = r.returncode == 0
+    except (OSError, subprocess.SubprocessError):
+        _probe_result = False
+    return _probe_result
+
+
+_probe_result = None
 
 
 def resolve_device(requested: str = "auto") -> str:
@@ -19,7 +72,16 @@ def resolve_device(requested: str = "auto") -> str:
     """
     import jax
 
+    enable_compile_cache()
     if requested == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        return jax.default_backend()
+    if not probe_default_backend():
+        if requested == "tpu":
+            raise RuntimeError(
+                "accelerator requested but backend init failed or hung")
+        print("[ccsx-tpu] accelerator unavailable (init failed or hung); "
+              "using CPU", file=sys.stderr)
         jax.config.update("jax_platforms", "cpu")
         return jax.default_backend()
     try:

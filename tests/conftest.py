@@ -11,6 +11,9 @@ import os
 # plugin overrides JAX_PLATFORMS at import time, so the env var alone is
 # not enough — set the config explicitly before any backend initializes
 os.environ["JAX_PLATFORMS"] = "cpu"
+# tests force CPU in-process; the out-of-process backend probe (which
+# exists because the axon TPU tunnel can hang) is pointless here
+os.environ["CCSX_SKIP_PROBE"] = "1"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
