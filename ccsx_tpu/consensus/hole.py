@@ -15,16 +15,31 @@ from ccsx_tpu.consensus.windowed import windowed_gen
 from ccsx_tpu.ops import encode as enc
 
 
+def _traced(gen, tag: str):
+    """Wrap a consensus generator with the reference's -v level-2 logs
+    ('poa begin/end' per hole, main.c:466-467,521-522,645-646)."""
+    import sys
+
+    print(f"[ccsx-tpu] consensus begin {tag}", file=sys.stderr)
+    result = yield from gen
+    print(f"[ccsx-tpu] consensus end {tag}", file=sys.stderr)
+    return result
+
+
 def consensus_gen_for_zmw(zmw, aligner, cfg: CcsConfig):
     """The consensus generator for one hole, or None if it is skipped."""
     passes = prep.oriented_passes(zmw, aligner, cfg)
     if passes is None:
         return None
     if cfg.split_subread:
-        return windowed_gen(passes, cfg)
-    sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
-    return sm.consensus_gen(
-        passes, cfg.refine_iters, cfg.pass_buckets, cfg.max_passes)
+        gen = windowed_gen(passes, cfg)
+    else:
+        sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
+        gen = sm.consensus_gen(
+            passes, cfg.refine_iters, cfg.pass_buckets, cfg.max_passes)
+    if cfg.verbose >= 2:
+        gen = _traced(gen, f"{zmw.movie}/{zmw.hole}")
+    return gen
 
 
 def ccs_hole(zmw, aligner, cfg: CcsConfig) -> Optional[bytes]:

@@ -216,6 +216,14 @@ def oriented_passes(zmw, aligner, cfg):
 
     codes = enc.encode(zmw.seqs)
     segments = ccs_prepare(codes, zmw.lens, zmw.offs, aligner, cfg)
+    if cfg.verbose >= 1:
+        # segment dump, the reference's -v level 1 (main.c:477-479,533-535)
+        import sys
+
+        for s in segments:
+            print(f"[ccsx-tpu] {zmw.movie}/{zmw.hole} segment "
+                  f"offs={s.offs} len={s.length} reverse={int(s.reverse)}",
+                  file=sys.stderr)
     return [oriented_pass(codes, s) for s in segments]
 
 

@@ -118,6 +118,12 @@ def windowed_gen(passes: List[np.ndarray], cfg: CcsConfig):
                 break
 
             bp = find_breakpoint(rr, nseq, cfg)
+            if cfg.verbose >= 3:
+                # per-window breakpoint stats, -v level 3 (main.c:619-620)
+                import sys
+
+                print(f"[ccsx-tpu] window size={window_size} "
+                      f"msa_cols={rr.tlen} breakpoint={bp}", file=sys.stderr)
             if bp is None and window_size + cfg.window_add <= cfg.max_window:
                 window_size += cfg.window_add
                 continue

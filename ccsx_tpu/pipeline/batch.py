@@ -91,9 +91,10 @@ def _z_bucket(n: int) -> int:
 class BatchExecutor:
     """Groups RoundRequests by shape and runs one device round per group."""
 
-    def __init__(self, cfg: CcsConfig):
+    def __init__(self, cfg: CcsConfig, metrics=None):
         self.cfg = cfg
         self.len_quant = cfg.len_bucket_quant
+        self.metrics = metrics
 
     def run(self, requests: List[RoundRequest]) -> List[RoundResult]:
         """Satisfy all requests; results align index-for-index."""
@@ -105,6 +106,9 @@ class BatchExecutor:
             groups[(P, qmax, tmax)].append(i)
 
         results: List[Optional[RoundResult]] = [None] * len(requests)
+        if self.metrics is not None:
+            self.metrics.windows += len(requests)
+            self.metrics.device_dispatches += len(groups)
         for (P, qmax, tmax), idxs in groups.items():
             n = len(idxs)
             Z = _z_bucket(n)
@@ -187,7 +191,7 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
     from ccsx_tpu.io import zmw as zmw_mod
 
     aligner = HostAligner(cfg.align)
-    executor = BatchExecutor(cfg)
+    executor = BatchExecutor(cfg, metrics=metrics)
     resume = journal.holes_done
     put_at = getattr(writer, "put_at", None)
 
