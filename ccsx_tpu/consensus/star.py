@@ -65,7 +65,10 @@ def _aligner(params: AlignParams):
     # handled by jit's own trace cache, so distinct (qmax, tmax) buckets
     # reuse this callable instead of rebuilding it.  The impl choice is
     # re-evaluated per call so CCSX_BANDED_IMPL works after first use.
-    scan_f = banded.make_batched("global", params, with_moves=True)
+    # with_stats=False: the consensus rounds use only (moves, offs); the
+    # slim carry drops the dead mat/aln channels from the DP scan
+    scan_f = banded.make_batched("global", params, with_moves=True,
+                                 with_stats=False)
 
     def f(qs, qlens, ts, tlens):
         qmax = qs.shape[-1]

@@ -86,7 +86,7 @@ def _pad_prev(row, maxshift):
 @functools.partial(
     jax.jit,
     static_argnames=("mode", "params", "band", "maxshift", "with_moves",
-                     "with_debug"),
+                     "with_debug", "with_stats"),
 )
 def banded_align(
     q: jnp.ndarray,
@@ -99,6 +99,7 @@ def banded_align(
     maxshift: int = 4,
     with_moves: bool = False,
     with_debug: bool = False,
+    with_stats: bool = True,
     line: tuple | None = None,
 ):
     """Align one (padded) query against one (padded) template.
@@ -125,6 +126,16 @@ def banded_align(
     """
     if with_moves and mode != "global":
         raise ValueError("moves only supported in global mode")
+    if not with_stats and mode != "global":
+        raise ValueError("with_stats=False only supported in global mode")
+    # the consensus hot path (global+moves) discards BandedResult entirely —
+    # only (moves, offs) feed the traceback.  with_stats=False drops the
+    # mat/aln/qb/tb channels and the per-row best tracker from the carry:
+    # 3 dynamic slices per row instead of 14, a 1-channel prefix scan
+    # instead of 5, no per-row gather.  Bitwise-identical moves/offs
+    # (tests/test_banded.py::test_with_stats_false_same_moves).
+    track_bt = mode != "global"          # qb/tb channels meaningful
+    track_stats = with_stats or track_bt  # mat/aln channels carried
     M, X = params.match, params.mismatch
     O, Eext = params.gap_open, params.gap_extend
     B = band if band is not None else params.band
@@ -173,20 +184,17 @@ def banded_align(
         aln0 = j0  # leading template-gap columns count toward aln
     qb0 = jnp.zeros((B,), jnp.int32)
     tb0 = j0 if mode == "local" else jnp.zeros((B,), jnp.int32)
-    Emat0, Ealn0, Eqb0, Etb0 = mat0, aln0, qb0, tb0
 
-    # best-tracker: (score, qe, mat, aln, qb, tb, te)
-    best0 = (
-        jnp.int32(NEG), jnp.int32(0), jnp.int32(0), jnp.int32(0),
-        jnp.int32(0), jnp.int32(0), jnp.int32(0),
-    )
-
-    carry0 = dict(
-        H=H0, E=E0, off=jnp.int32(0),
-        mat=mat0, aln=aln0, qb=qb0, tb=tb0,
-        Emat=Emat0, Ealn=Ealn0, Eqb=Eqb0, Etb=Etb0,
-        best=best0,
-    )
+    carry0 = dict(H=H0, E=E0, off=jnp.int32(0))
+    if track_stats:
+        carry0.update(mat=mat0, aln=aln0, Emat=mat0, Ealn=aln0)
+    if track_bt:
+        carry0.update(qb=qb0, tb=tb0, Eqb=qb0, Etb=tb0)
+        # best-tracker: (score, qe, mat, aln, qb, tb, te)
+        carry0["best"] = (
+            jnp.int32(NEG), jnp.int32(0), jnp.int32(0), jnp.int32(0),
+            jnp.int32(0), jnp.int32(0), jnp.int32(0),
+        )
 
     def body(carry, xs):
         i, qi = xs  # i in 1..Qmax; qi = q[i-1]
@@ -218,53 +226,55 @@ def banded_align(
         Hd_diag = shifted(H_prev, 0)
         H_up = shifted(H_prev, 1)
         E_up = shifted(E_prev, 1)
-        mat_diag = shifted(carry["mat"], 0)
-        aln_diag = shifted(carry["aln"], 0)
-        qb_diag = shifted(carry["qb"], 0)
-        tb_diag = shifted(carry["tb"], 0)
-        mat_up = shifted(carry["mat"], 1)
-        aln_up = shifted(carry["aln"], 1)
-        qb_up = shifted(carry["qb"], 1)
-        tb_up = shifted(carry["tb"], 1)
-        Emat_up = shifted(carry["Emat"], 1)
-        Ealn_up = shifted(carry["Ealn"], 1)
-        Eqb_up = shifted(carry["Eqb"], 1)
-        Etb_up = shifted(carry["Etb"], 1)
+        if track_stats:
+            mat_diag = shifted(carry["mat"], 0)
+            aln_diag = shifted(carry["aln"], 0)
+            mat_up = shifted(carry["mat"], 1)
+            aln_up = shifted(carry["aln"], 1)
+            Emat_up = shifted(carry["Emat"], 1)
+            Ealn_up = shifted(carry["Ealn"], 1)
+        if track_bt:
+            qb_diag = shifted(carry["qb"], 0)
+            tb_diag = shifted(carry["tb"], 0)
+            qb_up = shifted(carry["qb"], 1)
+            tb_up = shifted(carry["tb"], 1)
+            Eqb_up = shifted(carry["Eqb"], 1)
+            Etb_up = shifted(carry["Etb"], 1)
 
         # --- E (vertical: consume query base, gap in template) ---
         e_ext = E_up + Eext
         e_open = H_up + O + Eext
         e_is_open = e_open >= e_ext
         Enew = jnp.maximum(e_ext, e_open)
-        Emat = jnp.where(e_is_open, mat_up, Emat_up)
-        Ealn = jnp.where(e_is_open, aln_up, Ealn_up) + 1
-        Eqb = jnp.where(e_is_open, qb_up, Eqb_up)
-        Etb = jnp.where(e_is_open, tb_up, Etb_up)
+        if track_stats:
+            Emat = jnp.where(e_is_open, mat_up, Emat_up)
+            Ealn = jnp.where(e_is_open, aln_up, Ealn_up) + 1
+        if track_bt:
+            Eqb = jnp.where(e_is_open, qb_up, Eqb_up)
+            Etb = jnp.where(e_is_open, tb_up, Etb_up)
 
         # --- Hd = best of diag / E ---
         diag_term = Hd_diag + sub
         d_wins = diag_term >= Enew
         Hd = jnp.maximum(diag_term, Enew)
-        Hmat = jnp.where(d_wins, mat_diag + ismatch, Emat)
-        Haln = jnp.where(d_wins, aln_diag, Ealn - 1) + 1
-        Hqb = jnp.where(d_wins, qb_diag, Eqb)
-        Htb = jnp.where(d_wins, tb_diag, Etb)
+        if track_stats:
+            Hmat = jnp.where(d_wins, mat_diag + ismatch, Emat)
+            Haln = jnp.where(d_wins, aln_diag, Ealn - 1) + 1
+        if track_bt:
+            Hqb = jnp.where(d_wins, qb_diag, Eqb)
+            Htb = jnp.where(d_wins, tb_diag, Etb)
 
         # --- boundary lane j == 0 (only if off == 0) ---
         at0 = j == 0
         if mode == "global":
             b_H = O + Eext * i
-            b_mat, b_aln, b_qb, b_tb = 0, i, 0, 0
             Hd = jnp.where(at0, b_H, Hd)
             Enew = jnp.where(at0, b_H, Enew)
-            Hmat = jnp.where(at0, b_mat, Hmat)
-            Haln = jnp.where(at0, b_aln, Haln)
-            Hqb = jnp.where(at0, b_qb, Hqb)
-            Htb = jnp.where(at0, b_tb, Htb)
-            Emat = jnp.where(at0, b_mat, Emat)
-            Ealn = jnp.where(at0, b_aln, Ealn)
-            Eqb = jnp.where(at0, b_qb, Eqb)
-            Etb = jnp.where(at0, b_tb, Etb)
+            if track_stats:
+                Hmat = jnp.where(at0, 0, Hmat)
+                Haln = jnp.where(at0, i, Haln)
+                Emat = jnp.where(at0, 0, Emat)
+                Ealn = jnp.where(at0, i, Ealn)
         elif mode == "qfree":
             Hd = jnp.where(at0, 0, Hd)
             Enew = jnp.where(at0, NEG, Enew)
@@ -280,25 +290,27 @@ def banded_align(
 
         # --- F (horizontal) via associative max-plus prefix scan ---
         v = Hd + O - Eext * karr
-        elems = (v, Hmat, Haln - karr, Hqb, Htb)
+        elems = (v,)
+        if track_stats:
+            elems += (Hmat, Haln - karr)
+        if track_bt:
+            elems += (Hqb, Htb)
         cum = jax.lax.associative_scan(_combine_rightmax, elems)
         sh = tuple(
             _shift_right(x, NEG if idx == 0 else 0)
             for idx, x in enumerate(cum)
         )
         F = sh[0] + Eext * karr
-        Fmat = sh[1]
-        Faln = sh[2] + karr
-        Fqb = sh[3]
-        Ftb = sh[4]
 
         # --- H = max(Hd, F) ---
         hd_wins = Hd >= F
         Hnew = jnp.maximum(Hd, F)
-        mat_new = jnp.where(hd_wins, Hmat, Fmat)
-        aln_new = jnp.where(hd_wins, Haln, Faln)
-        qb_new = jnp.where(hd_wins, Hqb, Fqb)
-        tb_new = jnp.where(hd_wins, Htb, Ftb)
+        if track_stats:
+            mat_new = jnp.where(hd_wins, Hmat, sh[1])
+            aln_new = jnp.where(hd_wins, Haln, sh[2] + karr)
+        if track_bt:
+            qb_new = jnp.where(hd_wins, Hqb, sh[3])
+            tb_new = jnp.where(hd_wins, Htb, sh[4])
 
         if mode == "local":
             clamp = Hnew < 0
@@ -323,10 +335,10 @@ def banded_align(
         else:
             moves_row = jnp.zeros((B,), jnp.uint8)
 
-        # --- trackers ---
-        best = carry["best"]
+        # --- trackers (the global result reads the final carry instead) ---
         live = i <= qlen
-        if mode == "qfree" or mode == "global":
+        if mode == "qfree":
+            best = carry["best"]
             laneT = tlen - off
             ok = live & (laneT >= 0) & (laneT < B)
             laneTc = jnp.clip(laneT, 0, B - 1)
@@ -337,7 +349,8 @@ def banded_align(
             )
             take = cand[0] > best[0]
             best = tuple(jnp.where(take, c, b) for c, b in zip(cand, best))
-        else:  # local
+        elif mode == "local":
+            best = carry["best"]
             masked = jnp.where(j <= tlen, Hnew, NEG)
             lane = jnp.argmax(masked).astype(jnp.int32)
             val = jnp.where(live, masked[lane], NEG)
@@ -354,12 +367,18 @@ def banded_align(
 
         new_carry = dict(
             H=frz(Hnew, H_prev), E=frz(Enew, E_prev), off=frz(off, off_prev),
-            mat=frz(mat_new, carry["mat"]), aln=frz(aln_new, carry["aln"]),
-            qb=frz(qb_new, carry["qb"]), tb=frz(tb_new, carry["tb"]),
-            Emat=frz(Emat, carry["Emat"]), Ealn=frz(Ealn, carry["Ealn"]),
-            Eqb=frz(Eqb, carry["Eqb"]), Etb=frz(Etb, carry["Etb"]),
-            best=best,
         )
+        if track_stats:
+            new_carry.update(
+                mat=frz(mat_new, carry["mat"]), aln=frz(aln_new, carry["aln"]),
+                Emat=frz(Emat, carry["Emat"]), Ealn=frz(Ealn, carry["Ealn"]),
+            )
+        if track_bt:
+            new_carry.update(
+                qb=frz(qb_new, carry["qb"]), tb=frz(tb_new, carry["tb"]),
+                Eqb=frz(Eqb, carry["Eqb"]), Etb=frz(Etb, carry["Etb"]),
+                best=best,
+            )
         if with_moves:
             ys = (moves_row, frz(off, off_prev))
         elif with_debug:
@@ -377,11 +396,14 @@ def banded_align(
         laneT = tlen - carry["off"]
         reachable = (laneT >= 0) & (laneT < B)  # band covered column tlen
         lane = jnp.clip(laneT, 0, B - 1)
+        zero = jnp.int32(0)
         res = BandedResult(
             score=jnp.where(reachable, carry["H"][lane], NEG),
             qb=jnp.int32(0), qe=qlen, tb=jnp.int32(0), te=tlen,
-            aln=jnp.where(reachable, carry["aln"][lane], 0),
-            mat=jnp.where(reachable, carry["mat"][lane], 0),
+            aln=jnp.where(reachable, carry["aln"][lane], 0)
+            if track_stats else zero,
+            mat=jnp.where(reachable, carry["mat"][lane], 0)
+            if track_stats else zero,
         )
     else:
         s, qe, mat, aln, qb, tb, te = carry["best"]
@@ -400,7 +422,7 @@ def banded_align(
 
 def make_batched(mode: str, params: AlignParams, band: int | None = None,
                  maxshift: int = 4, with_moves: bool = False,
-                 with_line: bool = False):
+                 with_line: bool = False, with_stats: bool = True):
     """A jitted, vmapped aligner with static config baked in.
 
     With ``with_line``, the batched function takes a fifth argument:
@@ -408,7 +430,7 @@ def make_batched(mode: str, params: AlignParams, band: int | None = None,
     """
     f = functools.partial(
         banded_align, mode=mode, params=params, band=band,
-        maxshift=maxshift, with_moves=with_moves,
+        maxshift=maxshift, with_moves=with_moves, with_stats=with_stats,
     )
     if with_line:
         return jax.jit(jax.vmap(lambda q, ql, t, tl, line: f(q, ql, t, tl, line=line)))

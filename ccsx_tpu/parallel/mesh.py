@@ -62,7 +62,8 @@ def make_sharded_round(mesh: Mesh, params: AlignParams, tmax: int,
     projector = traceback.make_projector(tmax, max_ins)
 
     align_one = functools.partial(
-        banded.banded_align, mode="global", params=params, with_moves=True)
+        banded.banded_align, mode="global", params=params, with_moves=True,
+        with_stats=False)
 
     def local_round(qs, qlens, ts, tlens, row_mask):
         # vmap over local ZMWs and local passes

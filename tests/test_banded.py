@@ -182,3 +182,29 @@ def test_params_band_is_respected(rng):
     # with band 16 the fill still works on near-diagonal pairs
     want = oracle.align(q, t, mode="global", **SCORES)
     assert int(res.score) == want.score
+
+
+def test_with_stats_false_same_moves(rng):
+    """The slim hot-path carry (with_stats=False) must emit bitwise
+    identical moves/offs/score to the full-stats spec."""
+    import jax
+
+    from ccsx_tpu.config import AlignParams
+
+    params = AlignParams()
+    Q = T = 256
+    n = 8
+    qs = rng.integers(0, 4, (n, Q)).astype(np.uint8)
+    ts = rng.integers(0, 4, (n, T)).astype(np.uint8)
+    qlens = rng.integers(Q - 60, Q, n).astype(np.int32)
+    tlens = rng.integers(T - 60, T, n).astype(np.int32)
+    full = banded.make_batched("global", params, with_moves=True)
+    slim = banded.make_batched("global", params, with_moves=True,
+                               with_stats=False)
+    r1, m1, o1 = jax.block_until_ready(full(qs, qlens, ts, tlens))
+    r2, m2, o2 = jax.block_until_ready(slim(qs, qlens, ts, tlens))
+    np.testing.assert_array_equal(np.asarray(m1), np.asarray(m2))
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    np.testing.assert_array_equal(np.asarray(r1.score), np.asarray(r2.score))
+    # stats channels are intentionally absent: reported as zero
+    assert int(np.asarray(r2.aln).max()) == 0
