@@ -51,10 +51,6 @@ from ccsx_tpu.ops.banded import (
     BandedResult, EBIT_EXT, FBIT_EXT, MOVE_DIAG, MOVE_LEFT, MOVE_UP, NEG, PAD,
 )
 
-# rows of the carry block: H, E, mat, aln, Emat, Ealn
-_CH = 6
-_ROW_H, _ROW_E, _ROW_MAT, _ROW_ALN, _ROW_EMAT, _ROW_EALN = range(_CH)
-
 PALLAS_MAX_QMAX = 4096  # beyond this fall back to the scan implementation
 
 
@@ -108,35 +104,59 @@ def compute_ismatch(q, t, offs, band: int, maxshift: int):
 
 
 ROWBLOCK = 8  # rows per grid step: aligned sublane tiles for loads/stores
+GBLOCK = 8    # alignments per grid step, stacked in the sublane axis
 
 
-def _kernel(offs_ref, qlen_ref, tlen_ref, ismatch_ref, moves_ref, fin_ref,
-            ch_ref, *, qmax: int, band: int, maxshift: int,
-            params: AlignParams):
+# rows of the G-batched carry: H, E, mat, aln, Emat, Ealn, OFF
+_CHG = 7
+_G_OFF = 6
+
+
+def _kernel_g(dmat_ref, live_ref, tlen_ref, ismatch_ref, moves_ref, fin_ref,
+              ch_ref, *, qmax: int, band: int, maxshift: int,
+              params: AlignParams):
+    """G-batched banded DP fill: GBLOCK alignments per grid step.
+
+    The first kernel revision processed one alignment per grid step, so
+    every VPU op ran on a (1, B) sliver — 1/8 sublane utilization, and it
+    lost to XLA's vmapped scan ~5.7x.  Here GBLOCK alignments ride the
+    sublane axis: the carry is (7, G, B) VMEM scratch, all recurrence math
+    is (G, B) tiles, and per-problem row scalars (band shift d, live mask,
+    tlen) enter as (G, 1) columns broadcast across lanes.
+
+    Inputs (blocks):
+      dmat_ref    (G, ROWBLOCK) int32  — d = offs[i] - offs[i-1] per row
+      live_ref    (G, ROWBLOCK) int32  — 1 while i <= qlen
+      tlen_ref    (G, 1) int32
+      ismatch_ref (G, ROWBLOCK, B) int32
+    Outputs: moves (G, ROWBLOCK, B) uint8; fin (G, 8, B) int32 rows
+    0/1/2 = final H/mat/aln bands.
+    """
     M, X = params.match, params.mismatch
     O, E = params.gap_open, params.gap_extend
     B = band
+    G = GBLOCK
     r = pl.program_id(1)
-    qlen = qlen_ref[0, 0, 0]
-    tlen = tlen_ref[0, 0, 0]
     karr = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
-    negf = jnp.full((_CH, 1), NEG, jnp.int32)
+    tlen_col = tlen_ref[:, 0:1]                      # (G, 1)
+    negf = jnp.full((_CHG, G, 1), NEG, jnp.int32)
 
     def shift_ch(ch, s):
-        """Static lane shift: out[:, k] = ch[:, k+s], NEG fill (matches
-        _pad_prev in ops/banded.py, which pads NEG on both sides)."""
+        """Static lane shift of the full carry: out[..., k] = ch[..., k+s],
+        NEG fill (matches _pad_prev in ops/banded.py)."""
         if s == 0:
             return ch
         if s > 0:
             return jnp.concatenate(
-                [ch[:, s:], jnp.broadcast_to(negf, (_CH, s))], axis=1)
+                [ch[:, :, s:], jnp.broadcast_to(negf, (_CHG, G, s))], axis=2)
         return jnp.concatenate(
-            [jnp.broadcast_to(negf, (_CH, -s)), ch[:, :s]], axis=1)
+            [jnp.broadcast_to(negf, (_CHG, G, -s)), ch[:, :, :s]], axis=2)
 
     def shift_row(x, s, fill):
+        """Static lane shift of one (G, B) tile."""
         if s == 0:
             return x
-        f = jnp.full((x.shape[0], abs(s)), fill, x.dtype)
+        f = jnp.full((G, abs(s)), fill, x.dtype)
         if s > 0:
             return jnp.concatenate([x[:, s:], f], axis=1)
         return jnp.concatenate([f, x[:, :s]], axis=1)
@@ -144,44 +164,39 @@ def _kernel(offs_ref, qlen_ref, tlen_ref, ismatch_ref, moves_ref, fin_ref,
     # ---- row 0 init (off = 0), exactly ops/banded.py carry0 ----
     @pl.when(r == 0)
     def _():
-        j0 = karr
-        H0 = jnp.where(j0 <= tlen, jnp.where(j0 == 0, 0, O + E * j0), NEG)
-        E0 = jnp.full((1, B), NEG, jnp.int32)
-        mat0 = jnp.zeros((1, B), jnp.int32)
-        aln0 = j0
-        ch_ref[:] = jnp.concatenate([H0, E0, mat0, aln0, mat0, aln0], axis=0)
+        j0 = jnp.broadcast_to(karr, (G, B))
+        H0 = jnp.where(j0 <= tlen_col,
+                       jnp.where(j0 == 0, 0, O + E * j0), NEG)
+        E0 = jnp.full((G, B), NEG, jnp.int32)
+        z = jnp.zeros((G, B), jnp.int32)
+        ch_ref[:] = jnp.stack([H0, E0, z, j0, z, j0, z], axis=0)
 
-    # int32 throughout: sublane slices of i1 vectors hit Mosaic relayout
-    # limits, so the match indicator stays arithmetic (0/1)
-    ismatch_tile = ismatch_ref[0].astype(jnp.int32)  # (ROWBLOCK, B)
+    # int32 throughout: i8 sublane slices hit Mosaic relayout limits
+    ismatch_tile = ismatch_ref[...].astype(jnp.int32)  # (G, ROWBLOCK, B)
     ch = ch_ref[:]
     moves_rows = []
     for s in range(ROWBLOCK):
         i = r * ROWBLOCK + s + 1
-        off = offs_ref[0, 0, i - 1]
-        off_prev = jnp.where(i == 1, 0, offs_ref[0, 0, jnp.maximum(i - 2, 0)])
-        d = off - off_prev
+        d_col = dmat_ref[:, s:s + 1]                 # (G, 1)
+        live_col = live_ref[:, s:s + 1] != 0         # (G, 1) bool
 
         # select the d-shifted views of the carry (diag wants shift d-1)
         s_diag = shift_ch(ch, -1)
-        s_up = shift_ch(ch, 0)
+        s_up = ch
         for dd in range(1, maxshift + 1):
-            s_diag = jnp.where(d == dd, shift_ch(ch, dd - 1), s_diag)
-            s_up = jnp.where(d == dd, shift_ch(ch, dd), s_up)
+            take = (d_col == dd)[None]               # (1, G, 1)
+            s_diag = jnp.where(take, shift_ch(ch, dd - 1), s_diag)
+            s_up = jnp.where(take, shift_ch(ch, dd), s_up)
 
-        Hd_diag = s_diag[_ROW_H:_ROW_H + 1]
-        mat_diag = s_diag[_ROW_MAT:_ROW_MAT + 1]
-        aln_diag = s_diag[_ROW_ALN:_ROW_ALN + 1]
-        H_up = s_up[_ROW_H:_ROW_H + 1]
-        E_up = s_up[_ROW_E:_ROW_E + 1]
-        mat_up = s_up[_ROW_MAT:_ROW_MAT + 1]
-        aln_up = s_up[_ROW_ALN:_ROW_ALN + 1]
-        Emat_up = s_up[_ROW_EMAT:_ROW_EMAT + 1]
-        Ealn_up = s_up[_ROW_EALN:_ROW_EALN + 1]
+        Hd_diag, mat_diag, aln_diag = s_diag[0], s_diag[2], s_diag[3]
+        H_up, E_up = s_up[0], s_up[1]
+        mat_up, aln_up = s_up[2], s_up[3]
+        Emat_up, Ealn_up = s_up[4], s_up[5]
+        OFF = ch[_G_OFF] + d_col                     # this row's band offset
 
-        im = ismatch_tile[s:s + 1, :]  # (1, B) int32 0/1
+        im = ismatch_tile[:, s, :]                   # (G, B) int32 0/1
         sub = X + (M - X) * im
-        j = off + karr
+        j = OFF + karr
 
         # E (vertical)
         e_ext = E_up + E
@@ -209,13 +224,12 @@ def _kernel(offs_ref, qlen_ref, tlen_ref, ismatch_ref, moves_ref, fin_ref,
         Ealn = jnp.where(at0, i, Ealn)
 
         # invalid lanes beyond the template
-        invalid = j > tlen
+        invalid = j > tlen_col
         Hd = jnp.where(invalid, NEG, Hd)
         Enew = jnp.where(invalid, NEG, Enew)
 
-        # F (horizontal) max-plus prefix scan, Hillis-Steele over lanes.
-        # combine(left, right) keeps right on ties (ops/banded.py
-        # _combine_rightmax); shifted-in identity = NEG score.
+        # F (horizontal) max-plus prefix scan, Hillis-Steele over lanes;
+        # combine keeps right on ties (ops/banded.py _combine_rightmax)
         v = Hd + O - E * karr
         fm = Hmat
         fa = Haln - karr
@@ -250,22 +264,21 @@ def _kernel(offs_ref, qlen_ref, tlen_ref, ismatch_ref, moves_ref, fin_ref,
         H_left = shift_row(Hnew, -1, NEG)
         f_is_open = F == (H_left + O + E)
         fbit = jnp.where(f_is_open, 0, FBIT_EXT).astype(jnp.uint8)
-        moves_rows.append(choice | ebit | fbit)
+        moves_rows.append((choice | ebit | fbit)[:, None, :])
 
-        ch_new = jnp.concatenate(
-            [Hnew, Enew, mat_new, aln_new, Emat, Ealn], axis=0)
-        live = i <= qlen
-        ch = jnp.where(live, ch_new, ch)
+        ch_new = jnp.stack(
+            [Hnew, Enew, mat_new, aln_new, Emat, Ealn, OFF], axis=0)
+        ch = jnp.where(live_col[None], ch_new, ch)
 
-    moves_ref[0] = jnp.concatenate(moves_rows, axis=0)
+    moves_ref[...] = jnp.concatenate(moves_rows, axis=1)
     ch_ref[:] = ch
 
     @pl.when(r == pl.num_programs(1) - 1)
     def _():
-        fin_ref[0, 0:1, :] = ch[_ROW_H:_ROW_H + 1]
-        fin_ref[0, 1:2, :] = ch[_ROW_MAT:_ROW_MAT + 1]
-        fin_ref[0, 2:3, :] = ch[_ROW_ALN:_ROW_ALN + 1]
-        fin_ref[0, 3:8, :] = jnp.zeros((5, band), jnp.int32)
+        fin_ref[:, 0, :] = ch[0]
+        fin_ref[:, 1, :] = ch[2]
+        fin_ref[:, 2, :] = ch[3]
+        fin_ref[:, 3:8, :] = jnp.zeros((G, 5, band), jnp.int32)
 
 
 @functools.partial(
@@ -303,6 +316,17 @@ def batched_align_global_moves(
     ts_f = ts.reshape(n, ts.shape[-1])
     tlens_f = tlens.reshape(n).astype(jnp.int32)
 
+    # pad the problem axis to a GBLOCK multiple (pad rows: qlen 0, tlen 0)
+    npad = -(-n // GBLOCK) * GBLOCK
+    if npad != n:
+        pad = npad - n
+        qs_f = jnp.concatenate(
+            [qs_f, jnp.full((pad, qmax), PAD, qs_f.dtype)])
+        qlens_f = jnp.concatenate([qlens_f, jnp.zeros((pad,), jnp.int32)])
+        ts_f = jnp.concatenate(
+            [ts_f, jnp.full((pad, ts_f.shape[-1]), PAD, ts_f.dtype)])
+        tlens_f = jnp.concatenate([tlens_f, jnp.zeros((pad,), jnp.int32)])
+
     offs = jax.vmap(
         lambda ql, tl: compute_offsets(ql, tl, qmax, B, maxshift)
     )(qlens_f, tlens_f)
@@ -312,36 +336,45 @@ def batched_align_global_moves(
 
     if qmax % ROWBLOCK != 0:
         raise ValueError(f"qmax={qmax} must be a multiple of {ROWBLOCK}")
+    dmat = offs - jnp.concatenate(
+        [jnp.zeros((npad, 1), jnp.int32), offs[:, :-1]], axis=1)
+    rows = jnp.arange(1, qmax + 1, dtype=jnp.int32)
+    live = (rows[None, :] <= qlens_f[:, None]).astype(jnp.int32)
+
     kern = functools.partial(
-        _kernel, qmax=qmax, band=B, maxshift=maxshift, params=params)
+        _kernel_g, qmax=qmax, band=B, maxshift=maxshift, params=params)
     nb = qmax // ROWBLOCK
     moves, fin = pl.pallas_call(
         kern,
-        grid=(n, nb),
+        grid=(npad // GBLOCK, nb),
         in_specs=[
-            pl.BlockSpec((1, 1, qmax), lambda i, r: (i, 0, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, 1), lambda i, r: (i, 0, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, 1), lambda i, r: (i, 0, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, ROWBLOCK, B), lambda i, r: (i, r, 0),
+            pl.BlockSpec((GBLOCK, ROWBLOCK), lambda i, r: (i, r),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((GBLOCK, ROWBLOCK), lambda i, r: (i, r),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((GBLOCK, 1), lambda i, r: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((GBLOCK, ROWBLOCK, B), lambda i, r: (i, r, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, ROWBLOCK, B), lambda i, r: (i, r, 0),
+            pl.BlockSpec((GBLOCK, ROWBLOCK, B), lambda i, r: (i, r, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 8, B), lambda i, r: (i, 0, 0),
+            pl.BlockSpec((GBLOCK, 8, B), lambda i, r: (i, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n, qmax, B), jnp.uint8),
-            jax.ShapeDtypeStruct((n, 8, B), jnp.int32),
+            jax.ShapeDtypeStruct((npad, qmax, B), jnp.uint8),
+            jax.ShapeDtypeStruct((npad, 8, B), jnp.int32),
         ],
-        scratch_shapes=[pltpu.VMEM((_CH, B), jnp.int32)],
+        scratch_shapes=[pltpu.VMEM((_CHG, GBLOCK, B), jnp.int32)],
         interpret=interpret,
-    )(offs[:, None, :], qlens_f[:, None, None], tlens_f[:, None, None],
-      ismatch)
+    )(dmat, live, tlens_f[:, None], ismatch)
+    moves = moves[:n]
+    fin = fin[:n]
+    offs = offs[:n]
+    qlens_f = qlens_f[:n]
+    tlens_f = tlens_f[:n]
 
     # final-row extraction (mirrors ops/banded.py global-mode epilogue)
     off_fin = offs[:, -1]
