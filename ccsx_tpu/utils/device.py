@@ -72,7 +72,14 @@ def resolve_device(requested: str = "auto") -> str:
     """
     import jax
 
-    enable_compile_cache()
+    if requested == "auto" and os.environ.get(
+            "JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # the axon TPU plugin overrides JAX_PLATFORMS at import time;
+        # re-assert the user's explicit env choice here
+        requested = "cpu"
+    # the persistent cache is enabled only on accelerator paths: XLA:CPU
+    # AOT entries embed machine features and can be unsafe to reload
+    # (observed "+prefer-no-scatter not supported on host" E-logs)
     if requested == "cpu":
         jax.config.update("jax_platforms", "cpu")
         return jax.default_backend()
@@ -87,6 +94,8 @@ def resolve_device(requested: str = "auto") -> str:
     try:
         backend = jax.default_backend()
         jax.devices()
+        if backend != "cpu":
+            enable_compile_cache()
         return backend
     except RuntimeError as e:
         if requested == "tpu":
