@@ -73,7 +73,13 @@ def make_zmw(
     hole: str = "1",
     first_strand: int = 0,
     template: Optional[np.ndarray] = None,
+    partial_ends: bool = False,
 ) -> SynthZmw:
+    """With ``partial_ends``, the first and last passes are truncated
+    fragments (the polymerase starts/ends mid-molecule on real ZMWs) —
+    these fall outside the dominant length group, forcing the prepare
+    stage through its alignment-verified strand walk (main.c:392-406)
+    instead of the trusted-parity shortcut."""
     if template is None:
         template = rng.integers(0, 4, size=template_len).astype(np.uint8)
     passes, strands = [], []
@@ -82,6 +88,11 @@ def make_zmw(
         p = mutate(rng, template, sub_rate, ins_rate, del_rate)
         if strand:
             p = enc.revcomp_codes(p)
+        if partial_ends and n_passes >= 5 and k in (0, n_passes - 1):
+            frac = 0.3 + 0.3 * rng.random()  # keep 30-60%
+            keep = max(int(len(p) * frac), 50)
+            # first pass keeps its tail (run-up), last keeps its head
+            p = p[-keep:] if k == 0 else p[:keep]
         passes.append(p)
         strands.append(strand)
     return SynthZmw(movie=movie, hole=hole, template=template,

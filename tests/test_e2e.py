@@ -183,3 +183,32 @@ def test_cli_corrupt_bam_clean_error(tmp_path, capsys):
     rc = cli.main([str(p), str(tmp_path / "o.fa")])
     assert rc == 1
     assert "invalid input stream" in capsys.readouterr().err
+
+
+def test_windowed_partial_end_passes(rng):
+    """Real ZMWs have truncated first/last passes; the walk must drop the
+    short out-of-group fragments without aligning them (main.c:380,416)
+    and the consensus must still recover the template."""
+    cfg = CcsConfig(is_bam=False)
+    z = synth.make_zmw(rng, template_len=1200, n_passes=7,
+                       partial_ends=True)
+    assert len(z.passes[0]) < 1000 and len(z.passes[-1]) < 1000
+    zz = _zmw_from_synth(z)
+
+    calls = []
+    from ccsx_tpu.consensus.align_host import HostAligner
+
+    class CountingAligner(HostAligner):
+        def strand_match(self, q, t, pct):
+            calls.append(len(q))
+            return super().strand_match(q, t, pct)
+
+    from ccsx_tpu.consensus import prepare as prep
+    passes = prep.oriented_passes(zz, CountingAligner(cfg.align), cfg)
+    # 5 full passes kept, 2 partials dropped, no alignment dispatched
+    assert len(passes) == 5
+    assert calls == []
+
+    cns = windowed.ccs_windowed(zz, HostAligner(cfg.align), cfg)
+    idy = synth.identity_either(enc.encode(cns), z.template)
+    assert idy > 0.97
