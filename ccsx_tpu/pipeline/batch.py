@@ -89,12 +89,26 @@ def _z_bucket(n: int) -> int:
 
 
 class BatchExecutor:
-    """Groups RoundRequests by shape and runs one device round per group."""
+    """Groups RoundRequests by shape and runs one device round per group.
+
+    With more than one local device, batches are laid out over a 1-D
+    ``data`` mesh (ZMW axis sharded, SURVEY.md §5.8): the jitted round is
+    pure vmap, so XLA partitions it across the chips of a slice with no
+    cross-device traffic in the DP itself.
+    """
 
     def __init__(self, cfg: CcsConfig, metrics=None):
         self.cfg = cfg
         self.len_quant = cfg.len_bucket_quant
         self.metrics = metrics
+        self._sharding = None
+        n_dev = len(jax.devices())
+        if n_dev > 1:
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+            mesh = Mesh(np.array(jax.devices()), axis_names=("data",))
+            self._sharding = NamedSharding(mesh, PartitionSpec("data"))
+            self._ndev = n_dev
 
     def run(self, requests: List[RoundRequest]) -> List[RoundResult]:
         """Satisfy all requests; results align index-for-index."""
@@ -112,6 +126,8 @@ class BatchExecutor:
         for (P, qmax, tmax), idxs in groups.items():
             n = len(idxs)
             Z = _z_bucket(n)
+            if self._sharding is not None:
+                Z = max(Z, self._ndev)  # shardable over the data mesh axis
             qs = np.zeros((Z, P, qmax), np.uint8)
             qlens = np.zeros((Z, P), np.int32)
             ts = np.zeros((Z, tmax), np.uint8)
@@ -125,7 +141,10 @@ class BatchExecutor:
                 tlens[z] = len(req.draft)
                 row_mask[z] = req.row_mask
             step = _round_step(cfg.align, cfg.max_ins_per_col, tmax)
-            out = step(qs, qlens, ts, tlens, row_mask)
+            args = (qs, qlens, ts, tlens, row_mask)
+            if self._sharding is not None:
+                args = tuple(jax.device_put(a, self._sharding) for a in args)
+            out = step(*args)
             (cons, ins_base, ins_votes, ncov, match,
              aligned, ins_cnt, lead_ins) = (np.asarray(o) for o in out)
             for z, i in enumerate(idxs):

@@ -133,3 +133,29 @@ def test_whole_read_too_few_passes(rng):
     zz = _zmw_from_synth(z)
     aligner = HostAligner(CFG.align)
     assert whole_read.ccs_whole_read(zz, aligner, CFG) is None
+
+
+def test_quality_scales_with_passes(rng):
+    """CCS signature: consensus accuracy must rise with pass count
+    (>=Q20 by ~6 passes, >=Q25 by 10 at the default noise profile)."""
+    from ccsx_tpu.config import CcsConfig
+    from ccsx_tpu.consensus.whole_read import consensus_passes
+    from ccsx_tpu.ops import encode as enc
+    from ccsx_tpu.utils import synth
+
+    cfg = CcsConfig(is_bam=False)
+
+    def run(n):
+        idys = []
+        for _ in range(3):
+            z = synth.make_zmw(rng, template_len=700, n_passes=n)
+            ps = [enc.revcomp_codes(p) if s else p
+                  for p, s in zip(z.passes, z.strands)]
+            cns = consensus_passes(ps, cfg)
+            idys.append(synth.identity_either(cns, z.template))
+        return float(np.mean(idys))
+
+    i6, i10 = run(6), run(10)
+    assert i6 > 0.99, i6
+    assert i10 > 0.995, i10
+    assert i10 >= i6 - 1e-6
