@@ -18,7 +18,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from ccsx_tpu.config import AlignParams
-from ccsx_tpu.consensus.star import pad_to, quantize_len
+from ccsx_tpu.consensus.star import bucket_len, pad_to
 from ccsx_tpu.ops import banded, seed
 
 
@@ -44,8 +44,8 @@ class HostAligner:
 
     def _run(self, q: np.ndarray, t: np.ndarray,
              line: Optional[np.ndarray]) -> banded.BandedResult:
-        qp = pad_to(q, quantize_len(len(q), self.quant))
-        tp = pad_to(t, quantize_len(len(t), self.quant))
+        qp = pad_to(q, bucket_len(len(q), self.quant))
+        tp = pad_to(t, bucket_len(len(t), self.quant))
         return banded.banded_align(
             qp, np.int32(len(q)), tp, np.int32(len(t)),
             mode="local", params=self.params,

@@ -34,6 +34,21 @@ def quantize_len(n: int, q: int) -> int:
     return max(q, -(-n // q) * q)
 
 
+def bucket_len(n: int, q: int) -> int:
+    """Geometric length bucket (~1.25x steps, q-aligned).
+
+    Every distinct padded shape costs an XLA compile (tens of seconds on
+    TPU); linear q-quantization makes the shape count linear in sequence
+    length, this caps it at ~log.  Padding is masked, so results are
+    shape-invariant; both the per-hole round and the batched executor use
+    this SAME function, keeping their shapes (and jit caches) aligned.
+    """
+    b = q
+    while b < n:
+        b = max(b + q, (int(b * 1.25) // q) * q)
+    return b
+
+
 def pad_to(x: np.ndarray, n: int) -> np.ndarray:
     out = np.full(n, banded.PAD, np.uint8)
     out[: len(x)] = x
@@ -157,7 +172,7 @@ class StarMsa:
         """qs: (P, qmax) uint8 padded passes; draft: (tlen,) codes."""
         P, qmax = qs.shape
         tlen = len(draft)
-        tmax = quantize_len(tlen, self.len_quant)
+        tmax = bucket_len(tlen, self.len_quant)
         aligner = _aligner(self.params)
         projector_b = _projector(tmax, self.max_ins)
         voter = _voter(self.max_ins)
@@ -184,7 +199,7 @@ class StarMsa:
             passes = passes[:max_passes]
         P = pass_bucket(len(passes), pass_buckets)
         if qmax is None:
-            qmax = quantize_len(max(len(p) for p in passes), self.len_quant)
+            qmax = bucket_len(max(len(p) for p in passes), self.len_quant)
         qs = np.stack(
             [pad_to(p, qmax) for p in passes]
             + [np.full(qmax, banded.PAD, np.uint8)] * (P - len(passes)))

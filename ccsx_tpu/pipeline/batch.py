@@ -38,7 +38,7 @@ from ccsx_tpu.config import AlignParams, CcsConfig
 from ccsx_tpu.consensus.align_host import HostAligner
 from ccsx_tpu.consensus.hole import consensus_gen_for_zmw
 from ccsx_tpu.consensus.star import (
-    RoundRequest, RoundResult, pad_to, quantize_len,
+    RoundRequest, RoundResult, bucket_len, pad_to,
 )
 from ccsx_tpu.ops import encode as enc
 from ccsx_tpu.ops import traceback
@@ -116,7 +116,7 @@ class BatchExecutor:
         groups: Dict[tuple, List[int]] = defaultdict(list)
         for i, req in enumerate(requests):
             P, qmax = req.qs.shape
-            tmax = quantize_len(len(req.draft), self.len_quant)
+            tmax = bucket_len(len(req.draft), self.len_quant)
             groups[(P, qmax, tmax)].append(i)
 
         results: List[Optional[RoundResult]] = [None] * len(requests)

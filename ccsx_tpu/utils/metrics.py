@@ -23,6 +23,11 @@ class Metrics:
     holes_failed: int = 0
     windows: int = 0
     device_dispatches: int = 0
+    # per-stage wall time (SURVEY.md §5.1: the reference has no stage
+    # timing; the pipeline analog of its read/compute/write steps)
+    t_ingest: float = 0.0
+    t_compute: float = 0.0
+    t_write: float = 0.0
     t0: float = dataclasses.field(default_factory=time.monotonic)
 
     @property
@@ -40,6 +45,9 @@ class Metrics:
             "holes_failed": self.holes_failed,
             "windows": self.windows,
             "device_dispatches": self.device_dispatches,
+            "ingest_s": round(self.t_ingest, 3),
+            "compute_s": round(self.t_compute, 3),
+            "write_s": round(self.t_write, 3),
             "elapsed_s": round(self.elapsed, 3),
             "zmws_per_sec": round(self.zmws_per_sec, 3),
         }
