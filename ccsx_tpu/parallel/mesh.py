@@ -29,13 +29,14 @@ from ccsx_tpu.ops import banded, traceback
 
 
 def build_mesh(shape: Optional[Tuple[int, ...]] = None,
-               axis_names: Tuple[str, ...] = ("data", "pass")) -> Mesh:
-    """A (data, pass) mesh over the available devices.
+               axis_names: Tuple[str, ...] = ("data", "pass"),
+               devices=None) -> Mesh:
+    """A (data, pass) mesh over `devices` (default: all available).
 
     Default split: the pass axis gets 2 devices when there are >= 4 devices,
     otherwise 1 (pure data parallelism).
     """
-    devs = np.array(jax.devices())
+    devs = np.array(devices if devices is not None else jax.devices())
     n = len(devs)
     if shape is None:
         p = 2 if n >= 4 and n % 2 == 0 else 1

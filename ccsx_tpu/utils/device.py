@@ -36,29 +36,50 @@ def enable_compile_cache(path: str | None = None) -> str | None:
     return cache
 
 
-def probe_default_backend(timeout: float = 90.0) -> bool:
+def probe_default_backend(timeout: float | None = None,
+                          retries: int | None = None) -> bool:
     """True if the default JAX backend initializes in a fresh subprocess.
 
     The tunnelled TPU plugin can HANG on device init (not just fail), and
     an in-process hang cannot be timed out — so the probe runs out of
-    process.  Skipped (returns True) when CCSX_SKIP_PROBE is set.
+    process.  The tunnel is also *flaky*: the same shell can get a real
+    TPU on one attempt and an init failure on the next, so a failed
+    probe is retried with backoff before giving up.  Knobs:
+    CCSX_PROBE_TIMEOUT (seconds per attempt, default 120),
+    CCSX_PROBE_RETRIES (extra attempts after the first, default 1),
+    CCSX_SKIP_PROBE (skip entirely, treat backend as usable).
     """
     import subprocess
+    import time
 
     global _probe_result
     if os.environ.get("CCSX_SKIP_PROBE"):
         return True
     if _probe_result is not None:
         return _probe_result
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout, capture_output=True,
-        )
-        _probe_result = r.returncode == 0
-    except (OSError, subprocess.SubprocessError):
-        _probe_result = False
-    return _probe_result
+    if timeout is None:
+        timeout = float(os.environ.get("CCSX_PROBE_TIMEOUT", "120"))
+    if retries is None:
+        retries = int(os.environ.get("CCSX_PROBE_RETRIES", "1"))
+    for attempt in range(retries + 1):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=timeout, capture_output=True,
+            )
+            ok = r.returncode == 0
+        except (OSError, subprocess.SubprocessError):
+            ok = False
+        if ok:
+            _probe_result = True
+            return True
+        if attempt < retries:
+            backoff = 5.0 * (attempt + 1)
+            print(f"[ccsx-tpu] backend probe attempt {attempt + 1} failed; "
+                  f"retrying in {backoff:.0f}s", file=sys.stderr)
+            time.sleep(backoff)
+    _probe_result = False
+    return False
 
 
 _probe_result = None
