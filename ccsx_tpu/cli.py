@@ -118,6 +118,13 @@ def main(argv: Optional[list] = None) -> int:
         if args.host_id is None:
             print("Error: --hosts requires --host-id", file=sys.stderr)
             return 1
+        if args.batch == "off":
+            # the sharded driver is built on the batched scheduler (its
+            # shard writer needs per-hole ordinals); honoring 'off' would
+            # silently run batched anyway, so reject it instead
+            print("Error: --batch off is not supported with --hosts",
+                  file=sys.stderr)
+            return 1
         if args.coordinator is not None:
             from ccsx_tpu.parallel.distributed import init_distributed
 

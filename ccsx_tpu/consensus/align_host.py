@@ -6,8 +6,10 @@ main.c:255-290) and the static-shape device DP: k-mer diagonal seeding
 quantized shapes so XLA compilations are reused, and the acceptance rule is
 the reference's (main.c:280).
 
-This is the *scalar* path used by prepare; batched dispatch over whole
-chunks lives in consensus/runner.py.
+This is the scalar (one pair per dispatch) path used by prepare.  Measured
+at ~2ms/hole on CPU it is far from the prep bottleneck at current chunk
+sizes; if prep ever exceeds ~10% of wall time at device-round speed, the
+fix is batching these pair alignments through the same padded buckets.
 """
 
 from __future__ import annotations

@@ -42,11 +42,30 @@ def consensus_gen_for_zmw(zmw, aligner, cfg: CcsConfig):
     return gen
 
 
-def ccs_hole(zmw, aligner, cfg: CcsConfig) -> Optional[bytes]:
-    """Per-hole path: run the hole's generator with immediate rounds."""
+def _counted(gen, stats: dict):
+    """Count the generator's device rounds into stats['windows']."""
+    try:
+        req = next(gen)
+        while True:
+            stats["windows"] = stats.get("windows", 0) + 1
+            rr = yield req
+            req = gen.send(rr)
+    except StopIteration as e:
+        return e.value
+
+
+def ccs_hole(zmw, aligner, cfg: CcsConfig,
+             stats: Optional[dict] = None) -> Optional[bytes]:
+    """Per-hole path: run the hole's generator with immediate rounds.
+
+    stats, if given, receives per-hole counters ('windows': device rounds
+    run) so the driver can aggregate them thread-safely on its own side.
+    """
     gen = consensus_gen_for_zmw(zmw, aligner, cfg)
     if gen is None:
         return None
+    if stats is not None:
+        gen = _counted(gen, stats)
     sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
     codes = run_rounds(gen, sm)
     return enc.decode(codes).encode()

@@ -10,8 +10,11 @@ The reference's only parallelism is host threads over independent ZMWs
       axis — the tensor/sequence-parallel analog for this workload, riding
       ICI.
 
-The sharded step below is what __graft_entry__.dryrun_multichip exercises
-and what the batched runner uses on real multi-chip slices.
+The sharded step below is exercised by __graft_entry__.dryrun_multichip
+and the distributed tests.  The production batched runner
+(pipeline/batch.py) shards its rounds over the data axis only — ZMWs are
+independent, so pass-axis collectives only pay off for deep-pass holes on
+real multi-chip slices.
 """
 
 from __future__ import annotations

@@ -127,7 +127,10 @@ class BatchExecutor:
             n = len(idxs)
             Z = _z_bucket(n)
             if self._sharding is not None:
-                Z = max(Z, self._ndev)  # shardable over the data mesh axis
+                # the data-axis NamedSharding needs Z divisible by the
+                # device count (power-of-two Z alone is not enough when
+                # ndev isn't a power of two, e.g. 6 or 12 devices)
+                Z = -(-Z // self._ndev) * self._ndev
             qs = np.zeros((Z, P, qmax), np.uint8)
             qlens = np.zeros((Z, P), np.int32)
             ts = np.zeros((Z, tmax), np.uint8)
@@ -209,6 +212,9 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
     from ccsx_tpu.io import bam as bam_mod
     from ccsx_tpu.io import zmw as zmw_mod
 
+    # a non-positive in-flight window would make the admission condition
+    # permanently false and spin the scheduler forever
+    inflight = max(1, int(inflight))
     aligner = HostAligner(cfg.align)
     executor = BatchExecutor(cfg, metrics=metrics)
     resume = journal.holes_done
@@ -234,12 +240,14 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
                       f"failed: {h.err}", file=sys.stderr)
             elif h.cns:
                 name = f"{h.zmw.movie}/{h.zmw.hole}/ccs"
-                if put_at is not None:
-                    put_at(h.idx, name, h.cns)
-                else:
-                    writer.put(name, h.cns)
+                with metrics.timer("write"):
+                    if put_at is not None:
+                        put_at(h.idx, name, h.cns)
+                    else:
+                        writer.put(name, h.cns)
                 metrics.holes_out += 1
             journal.advance()
+            metrics.tick()
             next_emit += 1
 
     try:
@@ -250,7 +258,8 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
             while (not exhausted and len(active) < inflight
                    and next_idx - next_emit < 4 * inflight):
                 try:
-                    z = next(stream)
+                    with metrics.timer("ingest"):
+                        z = next(stream)
                 except StopIteration:
                     exhausted = True
                     break
@@ -260,7 +269,8 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
                 if metrics.holes_in <= resume:
                     h.done = h.resumed = True
                 else:
-                    _start_hole(h, aligner, cfg)
+                    with metrics.timer("compute"):
+                        _start_hole(h, aligner, cfg)
                 if h.done:
                     finished[h.idx] = h
                 else:
@@ -272,13 +282,15 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
                 continue
             # one batched device round over every pending request
             reqs = [h.req for h in active]
-            still: List[_Hole] = []
-            for h, rr in zip(active, executor.run(reqs)):
-                _advance_hole(h, rr)
-                if h.done:
-                    finished[h.idx] = h
-                else:
-                    still.append(h)
+            with metrics.timer("compute"):
+                round_results = executor.run(reqs)
+                still: List[_Hole] = []
+                for h, rr in zip(active, round_results):
+                    _advance_hole(h, rr)
+                    if h.done:
+                        finished[h.idx] = h
+                    else:
+                        still.append(h)
             active = still
             emit_ready()
     except (bam_mod.BamError, zmw_mod.InvalidZmwName, ValueError) as e:

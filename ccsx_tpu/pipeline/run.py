@@ -96,21 +96,31 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
     metrics = Metrics(verbose=cfg.verbose, stream=cfg.metrics_stream())
 
     def compute(z):
+        stats: dict = {}
         try:
-            return z, ccs_hole(z, aligner, cfg), None
+            return z, ccs_hole(z, aligner, cfg, stats), None, stats
         except Exception as e:  # quarantine: one bad hole must not kill the run
-            return z, None, e
+            return z, None, e, stats
 
     def write_result(item):
-        z, cns, err = item
-        if err is not None:
-            metrics.holes_failed += 1
-            print(f"[ccsx-tpu] hole {z.movie}/{z.hole} failed: {err}",
-                  file=sys.stderr)
-        elif cns:
-            writer.put(f"{z.movie}/{z.hole}/ccs", cns)
-            metrics.holes_out += 1
+        z, cns, err, stats = item
+        # per-hole counters aggregated here (driver side) so worker
+        # threads never touch the Metrics object concurrently.
+        # device_dispatches counts jitted device invocations: each
+        # per-hole round makes 3 (aligner, projector, voter — the
+        # batched executor fuses them into one jitted step per group)
+        metrics.windows += stats.get("windows", 0)
+        metrics.device_dispatches += 3 * stats.get("windows", 0)
+        with metrics.timer("write"):
+            if err is not None:
+                metrics.holes_failed += 1
+                print(f"[ccsx-tpu] hole {z.movie}/{z.hole} failed: {err}",
+                      file=sys.stderr)
+            elif cns:
+                writer.put(f"{z.movie}/{z.hole}/ccs", cns)
+                metrics.holes_out += 1
         journal.advance()
+        metrics.tick()
 
     rc = 0
     pool = ThreadPoolExecutor(max_workers=max(cfg.threads, 1)) \
@@ -119,21 +129,28 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
     try:
         while True:
             try:
-                z = next(stream)
+                with metrics.timer("ingest"):
+                    z = next(stream)
             except StopIteration:
                 break
             metrics.holes_in += 1
             if metrics.holes_in <= resume:
                 continue  # already written in a previous run
             if pool is None:
-                write_result(compute(z))
+                with metrics.timer("compute"):
+                    item = compute(z)
+                write_result(item)
             else:
                 pending.append(pool.submit(compute, z))
                 # bounded window keeps memory flat; drain in order
                 while len(pending) > 2 * cfg.threads:
-                    write_result(pending.popleft().result())
+                    with metrics.timer("compute"):
+                        item = pending.popleft().result()
+                    write_result(item)
         while pending:
-            write_result(pending.popleft().result())
+            with metrics.timer("compute"):
+                item = pending.popleft().result()
+            write_result(item)
     except (bam_mod.BamError, zmw.InvalidZmwName, ValueError) as e:
         print(f"Error: invalid input stream: {e}", file=sys.stderr)
         rc = 1

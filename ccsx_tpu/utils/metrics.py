@@ -7,6 +7,7 @@ north-star metric, BASELINE.md), and optional JSON-lines emission.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import sys
@@ -24,11 +25,37 @@ class Metrics:
     windows: int = 0
     device_dispatches: int = 0
     # per-stage wall time (SURVEY.md §5.1: the reference has no stage
-    # timing; the pipeline analog of its read/compute/write steps)
+    # timing; the pipeline analog of its read/compute/write steps).
+    # Attribution is at the driver loop: with worker threads, t_compute
+    # is the driver's wall time blocked on compute results.
     t_ingest: float = 0.0
     t_compute: float = 0.0
     t_write: float = 0.0
+    # a "progress" JSONL event is emitted every progress_every retired
+    # holes (0 disables); "final" is always emitted at report()
+    progress_every: int = 512
+    _ticked: int = 0
     t0: float = dataclasses.field(default_factory=time.monotonic)
+
+    @contextlib.contextmanager
+    def timer(self, stage: str):
+        """Accumulate a with-block's wall time into t_<stage>."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            attr = "t_" + stage
+            setattr(self, attr, getattr(self, attr)
+                    + time.perf_counter() - t0)
+
+    def tick(self) -> None:
+        """Called once per retired hole; emits periodic progress events."""
+        self._ticked += 1
+        if self.progress_every and self._ticked % self.progress_every == 0:
+            self.emit("progress")
+            if self.verbose:
+                print(f"[ccsx-tpu] progress {json.dumps(self.snapshot())}",
+                      file=sys.stderr)
 
     @property
     def elapsed(self) -> float:
@@ -45,9 +72,9 @@ class Metrics:
             "holes_failed": self.holes_failed,
             "windows": self.windows,
             "device_dispatches": self.device_dispatches,
-            "ingest_s": round(self.t_ingest, 3),
-            "compute_s": round(self.t_compute, 3),
-            "write_s": round(self.t_write, 3),
+            "ingest_s": round(self.t_ingest, 6),
+            "compute_s": round(self.t_compute, 6),
+            "write_s": round(self.t_write, 6),
             "elapsed_s": round(self.elapsed, 3),
             "zmws_per_sec": round(self.zmws_per_sec, 3),
         }
@@ -62,3 +89,10 @@ class Metrics:
         if self.verbose:
             print(f"[ccsx-tpu] {json.dumps(self.snapshot())}", file=sys.stderr)
         self.emit("final")
+        if self.stream is not None and self.stream not in (sys.stdout,
+                                                           sys.stderr):
+            try:
+                self.stream.close()
+            except OSError:
+                pass
+            self.stream = None

@@ -1,0 +1,121 @@
+"""Observability: stage timers, window counters, periodic progress
+events, the -v ladder, and metrics-stream lifecycle.
+
+The reference has no observability beyond -v stderr prints (SURVEY.md
+§5.1/§5.5); these tests pin the framework's replacement so the fields
+can't silently rot into fiction.
+"""
+
+import io
+import json
+
+import pytest
+
+from ccsx_tpu import cli
+from ccsx_tpu.config import CcsConfig
+from ccsx_tpu.io import fastx
+from ccsx_tpu.utils import synth
+from ccsx_tpu.utils.metrics import Metrics
+
+
+def _write_fasta(tmp_path, rng, n_holes=3, tlen=700, n_passes=5):
+    zs = [synth.make_zmw(rng, template_len=tlen, n_passes=n_passes,
+                         movie="mv", hole=str(h)) for h in range(n_holes)]
+    fa = tmp_path / "in.fa"
+    fa.write_text(synth.make_fasta(zs))
+    return zs, fa
+
+
+def _final_event(path):
+    events = [json.loads(line) for line in open(path)]
+    finals = [e for e in events if e["event"] == "final"]
+    assert len(finals) == 1
+    return finals[0], events
+
+
+@pytest.mark.parametrize("batch", ["off", "on"])
+def test_stage_timers_and_windows_are_written(tmp_path, rng, batch):
+    """t_ingest/t_compute/t_write and the window counters must be fed by
+    both drivers — they were once defined but never updated anywhere."""
+    _, fa = _write_fasta(tmp_path, rng)
+    out = tmp_path / "o.fa"
+    mpath = tmp_path / "m.jsonl"
+    assert cli.main(["-A", "-m", "1000", "--batch", batch,
+                     "--metrics", str(mpath), str(fa), str(out)]) == 0
+    final, _ = _final_event(mpath)
+    assert final["holes_out"] == 3
+    assert final["ingest_s"] > 0
+    assert final["compute_s"] > 0
+    assert final["write_s"] > 0
+    # each hole runs >= refine_iters+1 device rounds
+    assert final["windows"] >= 3 * (CcsConfig.refine_iters + 1)
+    assert final["device_dispatches"] > 0
+
+
+def test_progress_events_every_n_holes():
+    buf = io.StringIO()
+    m = Metrics(stream=buf, progress_every=2)
+    for _ in range(5):
+        m.holes_out += 1
+        m.tick()
+    events = [json.loads(line) for line in buf.getvalue().splitlines()]
+    progress = [e for e in events if e["event"] == "progress"]
+    assert len(progress) == 2
+    assert progress[0]["holes_out"] == 2
+    assert progress[1]["holes_out"] == 4
+
+
+def test_report_closes_file_stream(tmp_path):
+    p = tmp_path / "m.jsonl"
+    f = open(p, "a")
+    m = Metrics(stream=f)
+    m.report()
+    assert f.closed
+    assert m.stream is None
+    final, _ = _final_event(p)
+    assert final["event"] == "final"
+
+
+def test_verbose_ladder(tmp_path, rng, capsys):
+    """-v levels: 1 = oriented segment dump (main.c:477-479), 2 = consensus
+    begin/end per hole (main.c:466-467), 3 = per-window breakpoint stats
+    (main.c:619-620)."""
+    from ccsx_tpu.pipeline.run import run_pipeline
+
+    _, fa = _write_fasta(tmp_path, rng, n_holes=1, tlen=1500)
+    cfg = CcsConfig(is_bam=False, min_subread_len=1000, verbose=3,
+                    window_init=512, window_add=512, window_minlen=256,
+                    max_window=2048)
+    out = tmp_path / "o.fa"
+    assert run_pipeline(str(fa), str(out), cfg) == 0
+    err = capsys.readouterr().err
+    assert "segment offs=" in err          # level 1
+    assert "consensus begin mv/0" in err   # level 2
+    assert "consensus end mv/0" in err
+    assert "window size=" in err           # level 3
+    assert "breakpoint=" in err
+
+
+def test_verbose_level1_only(tmp_path, rng, capsys):
+    _, fa = _write_fasta(tmp_path, rng, n_holes=1)
+    out = tmp_path / "o.fa"
+    assert cli.main(["-A", "-m", "1000", "-v", str(fa), str(out)]) == 0
+    err = capsys.readouterr().err
+    assert "segment offs=" in err
+    assert "consensus begin" not in err
+    assert "window size=" not in err
+
+
+def test_negative_inflight_is_clamped(tmp_path, rng):
+    """--inflight <= 0 once spun the batched scheduler forever."""
+    _, fa = _write_fasta(tmp_path, rng, n_holes=2)
+    out = tmp_path / "o.fa"
+    assert cli.main(["-A", "-m", "1000", "--batch", "on",
+                     "--inflight", "-5", str(fa), str(out)]) == 0
+    assert len(list(fastx.read_fastx(str(out)))) == 2
+
+
+def test_batch_off_with_hosts_rejected(tmp_path):
+    rc = cli.main(["-A", "--hosts", "2", "--host-id", "0",
+                   "--batch", "off", "in.fa", "out.fa"])
+    assert rc == 1
