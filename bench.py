@@ -40,8 +40,10 @@ def measure():
     params = AlignParams()
     projector = traceback.make_projector(W, 4)
     voter = msa.make_voter(4)
-    # the production aligner dispatch: Pallas DP-fill kernel on TPU
-    # backends, the lax.scan spec elsewhere (consensus/star.py)
+    # the production aligner dispatch: the vmapped lax.scan fill by
+    # default on every backend (it beat the Pallas kernel 183k vs 142k
+    # zmw-windows/s on v5e, 2026-07-29 — see consensus/star.use_pallas);
+    # CCSX_BANDED_IMPL=pallas selects the kernel for A/B runs
     aligner = star._aligner(params)
 
     @jax.jit

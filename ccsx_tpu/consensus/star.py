@@ -57,16 +57,21 @@ def pad_to(x: np.ndarray, n: int) -> np.ndarray:
 
 def use_pallas() -> bool:
     """Banded DP-fill implementation choice; CCSX_BANDED_IMPL overrides
-    ({pallas, scan}).  The scan implementation is the spec — the kernel is
-    differential-tested bit-exact against it (tests/test_banded_pallas.py).
+    ({pallas, scan}).  The scan implementation is the spec — the G-batched
+    kernel (ops/banded_pallas.py) is differential-tested bit-exact against
+    it, on real TPU hardware with interpret=False (benchmarks/pallas_ab.py
+    --mode check, 2026-07-29, v5e) as well as in interpret mode
+    (tests/test_banded_pallas.py).
 
-    Default is the vmapped scan on every backend: measured on v5e, XLA's
-    compilation of it beats the current single-problem-per-grid-step Pallas
-    kernel ~5.7x (168k vs 29k zmw-windows/s on the bench.py round), because
-    the batch dimension (Z*P alignments) vectorizes across lanes while the
-    kernel only exploits the 128-lane band per step.  The kernel stays
-    available for A/B runs; batching alignments into its sublane axis is
-    the planned rework that would flip this default."""
+    Default is the vmapped scan on every backend.  Measured 2026-07-29 on
+    v5e (benchmarks/pallas_ab_tpu.json, interleaved medians at the bench
+    shapes Z=16 P=8 W=1024): scan round 183k zmw-windows/s vs pallas round
+    142k; DP-fill-only 5.9e10 vs 3.3e10 cells/s — XLA's compilation of the
+    scan, which vectorizes the Z*P alignment batch across lanes AND
+    pipelines rows, still beats the G=8-sublane-batched kernel ~1.3x on
+    the full round.  The kernel stays available for A/B runs
+    (CCSX_BANDED_IMPL=pallas) and as the fallback position if XLA's scan
+    lowering regresses."""
     impl = os.environ.get("CCSX_BANDED_IMPL", "")
     if impl not in ("", "pallas", "scan"):
         raise ValueError(

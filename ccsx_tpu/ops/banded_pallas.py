@@ -112,7 +112,7 @@ _CHG = 7
 _G_OFF = 6
 
 
-def _kernel_g(dmat_ref, live_ref, tlen_ref, ismatch_ref, moves_ref, fin_ref,
+def _kernel_g(tlen_ref, ismatch_ref, moves_ref, fin_ref,
               ch_ref, *, qmax: int, band: int, maxshift: int,
               params: AlignParams):
     """G-batched banded DP fill: GBLOCK alignments per grid step.
@@ -124,11 +124,18 @@ def _kernel_g(dmat_ref, live_ref, tlen_ref, ismatch_ref, moves_ref, fin_ref,
     is (G, B) tiles, and per-problem row scalars (band shift d, live mask,
     tlen) enter as (G, 1) columns broadcast across lanes.
 
+    Per-row scalars d (band shift, 0..maxshift) and live (i <= qlen) are
+    BIT-PACKED into lane 0 of the ismatch input (bits 1-3 and 4; bit 0
+    stays the match indicator on every lane): Mosaic requires lane-dim
+    blocks of 128 (so a (G, ROWBLOCK) scalar block never lowers on real
+    TPU) and dynamic lane slices must be 128-aligned (so a full-lane
+    scalar array can't be sliced per ROWBLOCK chunk either).  Riding the
+    already-aligned ismatch tile costs nothing.
+
     Inputs (blocks):
-      dmat_ref    (G, ROWBLOCK) int32  — d = offs[i] - offs[i-1] per row
-      live_ref    (G, ROWBLOCK) int32  — 1 while i <= qlen
       tlen_ref    (G, 1) int32
-      ismatch_ref (G, ROWBLOCK, B) int32
+      ismatch_ref (G, ROWBLOCK, B) int32 — bit 0 match; lane 0 carries
+                  d at bits 1-3 and live at bit 4
     Outputs: moves (G, ROWBLOCK, B) uint8; fin (G, 8, B) int32 rows
     0/1/2 = final H/mat/aln bands.
     """
@@ -139,27 +146,29 @@ def _kernel_g(dmat_ref, live_ref, tlen_ref, ismatch_ref, moves_ref, fin_ref,
     r = pl.program_id(1)
     karr = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
     tlen_col = tlen_ref[:, 0:1]                      # (G, 1)
-    negf = jnp.full((_CHG, G, 1), NEG, jnp.int32)
 
     def shift_ch(ch, s):
         """Static lane shift of the full carry: out[..., k] = ch[..., k+s],
-        NEG fill (matches _pad_prev in ops/banded.py)."""
+        NEG fill (matches _pad_prev in ops/banded.py).  Expressed as a
+        lane rotate + iota mask: Mosaic lowers tpu.rotate natively, while
+        lane-dim concatenates hit "offset mismatch on non-concat
+        dimension" and never compile on real TPU."""
         if s == 0:
             return ch
+        rolled = jnp.roll(ch, -s, axis=2)
+        k3 = karr[None]                              # (1, 1, B)
         if s > 0:
-            return jnp.concatenate(
-                [ch[:, :, s:], jnp.broadcast_to(negf, (_CHG, G, s))], axis=2)
-        return jnp.concatenate(
-            [jnp.broadcast_to(negf, (_CHG, G, -s)), ch[:, :, :s]], axis=2)
+            return jnp.where(k3 >= B - s, NEG, rolled)
+        return jnp.where(k3 < -s, NEG, rolled)
 
     def shift_row(x, s, fill):
-        """Static lane shift of one (G, B) tile."""
+        """Static lane shift of one (G, B) tile (rotate + mask)."""
         if s == 0:
             return x
-        f = jnp.full((G, abs(s)), fill, x.dtype)
+        rolled = jnp.roll(x, -s, axis=1)
         if s > 0:
-            return jnp.concatenate([x[:, s:], f], axis=1)
-        return jnp.concatenate([f, x[:, :s]], axis=1)
+            return jnp.where(karr >= B - s, fill, rolled)
+        return jnp.where(karr < -s, fill, rolled)
 
     # ---- row 0 init (off = 0), exactly ops/banded.py carry0 ----
     @pl.when(r == 0)
@@ -172,13 +181,15 @@ def _kernel_g(dmat_ref, live_ref, tlen_ref, ismatch_ref, moves_ref, fin_ref,
         ch_ref[:] = jnp.stack([H0, E0, z, j0, z, j0, z], axis=0)
 
     # int32 throughout: i8 sublane slices hit Mosaic relayout limits
-    ismatch_tile = ismatch_ref[...].astype(jnp.int32)  # (G, ROWBLOCK, B)
+    packed_tile = ismatch_ref[...].astype(jnp.int32)   # (G, ROWBLOCK, B)
+    ismatch_tile = packed_tile & 1
     ch = ch_ref[:]
     moves_rows = []
     for s in range(ROWBLOCK):
         i = r * ROWBLOCK + s + 1
-        d_col = dmat_ref[:, s:s + 1]                 # (G, 1)
-        live_col = live_ref[:, s:s + 1] != 0         # (G, 1) bool
+        lane0 = packed_tile[:, s, 0:1]               # (G, 1) packed scalars
+        d_col = (lane0 >> 1) & 7
+        live_col = ((lane0 >> 4) & 1) != 0           # (G, 1) bool
 
         # select the d-shifted views of the carry (diag wants shift d-1)
         s_diag = shift_ch(ch, -1)
@@ -302,6 +313,9 @@ def batched_align_global_moves(
     (BandedResult, moves, offs) result tuple.
     """
     B = band if band is not None else params.band
+    if maxshift > 7:
+        # d rides lane 0 of the ismatch tile in bits 1-3 (see _kernel_g)
+        raise ValueError(f"maxshift={maxshift} exceeds the 3-bit pack limit")
     lead = qs.shape[:-1]
     qmax = qs.shape[-1]
     if qmax > PALLAS_MAX_QMAX:
@@ -340,6 +354,11 @@ def batched_align_global_moves(
         [jnp.zeros((npad, 1), jnp.int32), offs[:, :-1]], axis=1)
     rows = jnp.arange(1, qmax + 1, dtype=jnp.int32)
     live = (rows[None, :] <= qlens_f[:, None]).astype(jnp.int32)
+    # bit-pack the per-row scalars into lane 0 of the ismatch tile (see
+    # _kernel_g docstring): bit 0 match, bits 1-3 d, bit 4 live
+    aux = (((dmat & 7) << 1) | (live << 4)).astype(jnp.int8)
+    lane_is0 = (jnp.arange(B, dtype=jnp.int32) == 0)[None, None, :]
+    ismatch = jnp.where(lane_is0, ismatch | aux[:, :, None], ismatch)
 
     kern = functools.partial(
         _kernel_g, qmax=qmax, band=B, maxshift=maxshift, params=params)
@@ -348,10 +367,6 @@ def batched_align_global_moves(
         kern,
         grid=(npad // GBLOCK, nb),
         in_specs=[
-            pl.BlockSpec((GBLOCK, ROWBLOCK), lambda i, r: (i, r),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((GBLOCK, ROWBLOCK), lambda i, r: (i, r),
-                         memory_space=pltpu.VMEM),
             pl.BlockSpec((GBLOCK, 1), lambda i, r: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((GBLOCK, ROWBLOCK, B), lambda i, r: (i, r, 0),
@@ -369,7 +384,7 @@ def batched_align_global_moves(
         ],
         scratch_shapes=[pltpu.VMEM((_CHG, GBLOCK, B), jnp.int32)],
         interpret=interpret,
-    )(dmat, live, tlens_f[:, None], ismatch)
+    )(tlens_f[:, None], ismatch)
     moves = moves[:n]
     fin = fin[:n]
     offs = offs[:n]

@@ -56,7 +56,7 @@ def _round_step(params: AlignParams, max_ins: int, tmax: int):
     from ccsx_tpu.consensus import star as star_mod
     from ccsx_tpu.ops import msa as msa_mod
 
-    aligner = star_mod._aligner(params)  # Pallas on TPU, scan otherwise
+    aligner = star_mod._aligner(params)  # scan default; env-gated Pallas
     projector = traceback.make_projector(tmax, max_ins)
     voter = msa_mod.make_voter(max_ins)
 

@@ -9,20 +9,23 @@ import os
 
 # force CPU with 8 virtual devices: the environment's axon (TPU tunnel)
 # plugin overrides JAX_PLATFORMS at import time, so the env var alone is
-# not enough — set the config explicitly before any backend initializes
-os.environ["JAX_PLATFORMS"] = "cpu"
-# tests force CPU in-process; the out-of-process backend probe (which
-# exists because the axon TPU tunnel can hang) is pointless here
+# not enough — set the config explicitly before any backend initializes.
+# CCSX_TEST_TPU=1 opts out, running the suite on the real chip (used to
+# run the Pallas differential tests with interpret=False on hardware).
+_ON_TPU = os.environ.get("CCSX_TEST_TPU") == "1"
 os.environ["CCSX_SKIP_PROBE"] = "1"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+if not _ON_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _ON_TPU:
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
