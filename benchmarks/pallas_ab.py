@@ -90,38 +90,32 @@ def _round_step(impl: str, W: int):
     params = AlignParams()
     projector = traceback.make_projector(W, 4)
     voter = msa.make_voter(4)
-    # trace-time dispatch: set the impl override only while building
-    prior = os.environ.get("CCSX_BANDED_IMPL")
-    os.environ["CCSX_BANDED_IMPL"] = impl
-    try:
-        aligner = star._aligner(params)
+    # NOTE: the impl dispatch happens at TRACE time (star._aligner reads
+    # use_pallas() when the jitted step first runs).  The caller
+    # (time_impl) holds the CCSX_BANDED_IMPL override through its warmup,
+    # which is when tracing occurs — do not call the returned step
+    # outside such a scope or the wrong impl gets traced and cached.
+    aligner = star._aligner(params)
 
-        @jax.jit
-        def step(qs, qlens, ts, tlens, row_mask):
-            Zb, Pb, qmax = qs.shape
-            ts_b = jax.numpy.broadcast_to(
-                ts[:, None, :], (Zb, Pb, ts.shape[-1]))
-            tl_b = jax.numpy.broadcast_to(tlens[:, None], (Zb, Pb))
-            _, moves, offs = aligner(
-                qs.reshape(Zb * Pb, qmax), qlens.reshape(Zb * Pb),
-                ts_b.reshape(Zb * Pb, -1), tl_b.reshape(Zb * Pb))
-            moves = moves.reshape(Zb, Pb, qmax, -1)
-            offs = offs.reshape(Zb, Pb, qmax)
-            proj = jax.vmap(jax.vmap(projector, in_axes=(0, 0, 0, 0, None)),
-                            in_axes=(0, 0, 0, 0, 0))
-            aligned, ins_cnt, ins_b, _lead = proj(
-                moves, offs, qs, qlens, tlens)
-            cons, ins_base, ins_votes, ncov, match = jax.vmap(voter)(
-                aligned, ins_cnt, ins_b, row_mask)
-            return cons, ncov
+    @jax.jit
+    def step(qs, qlens, ts, tlens, row_mask):
+        Zb, Pb, qmax = qs.shape
+        ts_b = jax.numpy.broadcast_to(
+            ts[:, None, :], (Zb, Pb, ts.shape[-1]))
+        tl_b = jax.numpy.broadcast_to(tlens[:, None], (Zb, Pb))
+        _, moves, offs = aligner(
+            qs.reshape(Zb * Pb, qmax), qlens.reshape(Zb * Pb),
+            ts_b.reshape(Zb * Pb, -1), tl_b.reshape(Zb * Pb))
+        moves = moves.reshape(Zb, Pb, qmax, -1)
+        offs = offs.reshape(Zb, Pb, qmax)
+        proj = jax.vmap(jax.vmap(projector, in_axes=(0, 0, 0, 0, None)),
+                        in_axes=(0, 0, 0, 0, 0))
+        aligned, ins_cnt, ins_b, _lead = proj(
+            moves, offs, qs, qlens, tlens)
+        cons, ins_base, ins_votes, ncov, match = jax.vmap(voter)(
+            aligned, ins_cnt, ins_b, row_mask)
+        return cons, ncov
 
-        # tracing happens at first call — time_impl holds the env
-        # override through its warmup, so the right impl is captured
-    finally:
-        if prior is None:
-            os.environ.pop("CCSX_BANDED_IMPL", None)
-        else:
-            os.environ["CCSX_BANDED_IMPL"] = prior
     _STEP_CACHE[key] = step
     return step
 
@@ -131,8 +125,9 @@ def time_impl(impl: str, Z, P, W, tlen, warmup=5, iters=100, repeats=3):
 
     Compiles once (cached across calls), then takes `repeats` timing
     windows of `iters` dispatches each; returns zmw_windows/s per
-    window.  The impl env override is scoped to trace time (try/finally
-    in _round_step) so a failure can't leak it into the process."""
+    window.  The CCSX_BANDED_IMPL override is held (try/finally) through
+    warmup — where the jitted step traces and the impl dispatch actually
+    happens — so a failure can't leak it into the process."""
     import jax
 
     prior = os.environ.get("CCSX_BANDED_IMPL")
@@ -157,7 +152,7 @@ def time_impl(impl: str, Z, P, W, tlen, warmup=5, iters=100, repeats=3):
     return runs
 
 
-def time_fill_only(impl: str, Z, P, W, tlen, band=128, warmup=5, iters=300,
+def time_fill_only(impl: str, Z, P, W, tlen, warmup=5, iters=300,
                    repeats=3):
     """Time just the DP fill (no projection/vote) — isolates the kernel.
 
@@ -188,6 +183,9 @@ def time_fill_only(impl: str, Z, P, W, tlen, band=128, warmup=5, iters=300,
                 return scan_f(qs, qlens, ts, tlens)
         _STEP_CACHE[key] = fill
 
+    from ccsx_tpu.config import AlignParams as _AP
+
+    band = _AP().band  # the band the fill actually runs at
     qs, qlens, ts, tlens, _ = _bench_args(Z, P, W, tlen)
     n = Z * P
     qs_f = qs.reshape(n, W)

@@ -11,10 +11,12 @@ The reference's only parallelism is host threads over independent ZMWs
       ICI.
 
 The sharded step below is exercised by __graft_entry__.dryrun_multichip
-and the distributed tests.  The production batched runner
-(pipeline/batch.py) shards its rounds over the data axis only — ZMWs are
-independent, so pass-axis collectives only pay off for deep-pass holes on
-real multi-chip slices.
+and tests/test_sharded_round.py, both of which assert its four outputs
+equal the unsharded per-hole star round BIT-EXACTLY (the vote is a pure
+pass-axis reduction, so sharding must change nothing).  The production
+batched runner (pipeline/batch.py) shards its rounds over the data axis
+only — ZMWs are independent, so pass-axis collectives only pay off for
+deep-pass holes on real multi-chip slices.
 """
 
 from __future__ import annotations
