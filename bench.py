@@ -114,8 +114,15 @@ def main():
         baseline = b.get("zmw_windows_per_sec")
         baseline_simd = b.get("zmw_windows_per_sec_simd")
         # the unit conversion must match the baseline's, or the ratio
-        # silently compares mismatched units
-        cells_per_zw = b.get("cells_per_zmw_window", cells_per_zw)
+        # silently compares mismatched units; if the bench geometry has
+        # drifted from the artifact, refuse the ratio until --calibrate
+        stored = b.get("cells_per_zmw_window")
+        if stored is not None and stored != cells_per_zw:
+            print(f"[bench] geometry drift: baseline artifact has "
+                  f"{stored} cells/zmw-window, bench shapes give "
+                  f"{cells_per_zw}; re-run `python bench.py --calibrate` "
+                  "(vs_baseline suppressed)", file=sys.stderr)
+            baseline = baseline_simd = None
 
     import jax
 

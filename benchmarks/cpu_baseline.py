@@ -38,8 +38,13 @@ import numpy as np
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
-# bench.py round-unit geometry (keep in sync with bench.py Z/P/W)
-P, W, BAND = 8, 1024, 128
+# bench.py round-unit geometry — imported, not duplicated, so the
+# artifact's cells_per_zmw_window can never drift from the bench shapes
+# (bench.py refuses vs_baseline when it detects a mismatch anyway)
+import bench as _bench  # noqa: E402  (repo root is on sys.path above)
+
+P, W = _bench.P, _bench.W
+BAND = 128  # AlignParams().band == the bench round's band
 CELLS_PER_ZMW_WINDOW = P * W * BAND
 
 SIMD_CREDIT = 8.0

@@ -1,0 +1,155 @@
+"""Prep-share measurement (VERDICT r2 item 6; align_host.py's criterion).
+
+Times host prep (ccs_prepare: orientation/clip strand_match walk) over a
+mixed chunk of >=1024 holes and compares it against the device-round time
+the same holes' consensus needs:
+
+  * measured        — prep_s vs compute_s from a real batched pipeline
+                      run on the resolved backend;
+  * at-peak projection — compute projected at bench.py round speed
+                      (windows x per-window dispatch time at the bench's
+                      measured zmw_windows/s), the criterion the
+                      align_host.py docstring states: if prep exceeds
+                      ~10% of wall time at device-round speed, batch it.
+
+Usage: python benchmarks/prep_share.py [--holes N] [--json out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from ccsx_tpu import cli                                     # noqa: E402
+from ccsx_tpu.utils import synth                             # noqa: E402
+
+
+def make_holes(rng, n):
+    """Mixed chunk: varying lengths, pass counts, partial ends."""
+    zs = []
+    for h in range(n):
+        tlen = int(rng.integers(600, 2600))
+        n_passes = int(np.clip(round(rng.lognormal(np.log(8), 0.5)), 5, 24))
+        zs.append(synth.make_zmw(
+            rng, tlen, n_passes, movie="mv", hole=str(h),
+            partial_ends=bool(h % 3 == 0)))
+    return zs
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--holes", type=int, default=1024)
+    ap.add_argument("--json", default=None)
+    ap.add_argument("--device", default="auto",
+                    choices=["auto", "tpu", "cpu"])
+    ap.add_argument("--bench-zmw-windows-per-sec", type=float, default=None,
+                    help="round speed for the at-peak projection "
+                         "[read BENCH value or bench_peak.json]")
+    a = ap.parse_args()
+
+    from ccsx_tpu.utils.device import resolve_device
+
+    resolve_device(a.device)
+    import jax
+
+    rng = np.random.default_rng(11)
+    zs = make_holes(rng, a.holes)
+    with tempfile.TemporaryDirectory() as tmp:
+        fa = os.path.join(tmp, "in.fa")
+        open(fa, "w").write(synth.make_fasta(zs))
+        out = os.path.join(tmp, "out.fa")
+        met = os.path.join(tmp, "m.jsonl")
+        t0 = time.perf_counter()
+        rc = cli.main(["-A", "-m", "1000", "--batch", "on",
+                       "--metrics", met, fa, out])
+        wall = time.perf_counter() - t0
+        assert rc == 0
+        final = [json.loads(line) for line in open(met)][-1]
+
+    prep_s = final["prep_s"]
+    compute_s = final["compute_s"]
+    windows = final["windows"]
+    res = {
+        "backend": jax.default_backend(),
+        "holes": a.holes,
+        "wall_s": round(wall, 2),
+        "prep_s": prep_s,
+        "compute_s": compute_s,
+        "ingest_s": final["ingest_s"],
+        "write_s": final["write_s"],
+        "windows": windows,
+        "device_dispatches": final["device_dispatches"],
+        "prep_ms_per_hole": round(prep_s / a.holes * 1e3, 3),
+        "prep_share_measured": round(prep_s / max(wall, 1e-9), 4),
+    }
+    # at-peak projection: what the share becomes when the device rounds
+    # run at bench.py speed (each zmw-window ~ 1/bench_rate seconds).
+    # Window shapes here are close to the bench shapes (P<=16, W<=2560);
+    # the projection is deliberately rough — order-of-magnitude is what
+    # the 10% criterion needs.
+    rate = a.bench_zmw_windows_per_sec
+    if rate is None:
+        rate = 170000.0  # v5e measured 2026-07-29 (BENCH_r03 ballpark)
+    proj_compute = windows / rate
+    res["peak_zmw_windows_per_sec"] = rate
+    res["projected_compute_s_at_peak"] = round(proj_compute, 4)
+    res["prep_share_at_peak"] = round(
+        prep_s / max(prep_s + proj_compute, 1e-9), 4)
+
+    # direct A/B of the pair-alignment batching (PairExecutor vs the
+    # per-pair HostAligner path) on alignment-heavy pairs — the synthetic
+    # chunk above rarely aligns (its fragments are skipped pre-alignment:
+    # walk() drops out-of-group passes shorter than the template), so the
+    # residual prep_s there is host Python (group_lens + generator
+    # startup), not pair fills
+    from ccsx_tpu.config import AlignParams
+    from ccsx_tpu.consensus import prepare as prep_mod
+    from ccsx_tpu.consensus.align_host import HostAligner
+    from ccsx_tpu.pipeline.batch import PairExecutor
+
+    pr_rng = np.random.default_rng(5)
+    pairs = []
+    for _ in range(64):
+        tl = int(pr_rng.integers(1200, 1600))
+        tpl = pr_rng.integers(0, 4, tl).astype(np.uint8)
+        q = synth.mutate(pr_rng, tpl, 0.03, 0.05, 0.05)
+        pairs.append(prep_mod.PairRequest(q, tpl, 75))
+    host = HostAligner(AlignParams())
+    pe = PairExecutor(AlignParams())
+    # warmup both paths (compiles)
+    host.strand_match(pairs[0].q, pairs[0].t, 75)
+    pe.run(pairs[:2])
+    t0 = time.perf_counter()
+    for pr in pairs:
+        host.strand_match(pr.q, pr.t, pr.pct)
+    t_host = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    batched = pe.run(pairs)
+    t_batch = time.perf_counter() - t0
+    per_pair = [host.strand_match(pr.q, pr.t, pr.pct) for pr in pairs]
+    agree = sum(a[0] == b[0] and a[1].qb == b[1].qb and a[1].qe == b[1].qe
+                for a, b in zip(per_pair, batched))
+    res["pair_ab"] = {
+        "pairs": len(pairs),
+        "per_pair_s": round(t_host, 4),
+        "batched_s": round(t_batch, 4),
+        "speedup": round(t_host / max(t_batch, 1e-9), 2),
+        "results_agree": agree,
+    }
+    print(json.dumps(res, indent=1))
+    if a.json:
+        with open(a.json, "w") as f:
+            json.dump(res, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

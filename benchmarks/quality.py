@@ -1,0 +1,267 @@
+"""Q20-yield parity gate + delta sweeps (SURVEY.md §7.2 step 2 fallback).
+
+The compiled reference binary is not buildable offline (bsalign is cloned
+at build time, reference README.md:11), so accuracy parity is gated the
+way the blueprint prescribes: >=Q20 consensus yield over a realistic
+pass-count distribution on the five BASELINE configs, plus explicit
+quantification of the two documented deltas vs the reference:
+
+  * max_window force-flush (windowed.py) vs the reference's unbounded
+    window growth (main.c:550,613-616) — swept on low-agreement
+    (high-error) holes with window_growth "flush" vs "grow";
+  * max_passes=32 pass cap (config.py) vs the reference's all-passes POA
+    (main.c:486-492) — swept on 40-60-pass holes.
+
+Q per hole = -10*log10(1 - identity) with identity from a global
+alignment vs the known template (better orientation); Q20 <=> identity
+>= 0.99.  Yield = emitted holes at >=Q20 / holes in.
+
+Usage: python benchmarks/quality.py [--holes N] [--json out.json]
+       (heavier sweeps: --full)
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import json
+import math
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from ccsx_tpu import cli                                     # noqa: E402
+from ccsx_tpu.config import CcsConfig                        # noqa: E402
+from ccsx_tpu.consensus import prepare as prep               # noqa: E402
+from ccsx_tpu.consensus.align_host import HostAligner        # noqa: E402
+from ccsx_tpu.consensus.windowed import consensus_windowed   # noqa: E402
+from ccsx_tpu.io import bam, fastx                           # noqa: E402
+from ccsx_tpu.ops import encode as enc                       # noqa: E402
+from ccsx_tpu.utils import synth                             # noqa: E402
+
+# per-pass subread error rates (PacBio CLR-like: ~10-13% total, indel
+# heavy).  The gate distribution draws pass counts log-normally: median
+# ~9, tail to ~30 — shaped like a Sequel II subreads length/pass profile
+ERR = dict(sub_rate=0.02, ins_rate=0.05, del_rate=0.05)
+
+
+def sample_pass_counts(rng, n, lo=5, hi=30):
+    counts = np.clip(np.round(rng.lognormal(np.log(9), 0.45, n)),
+                     lo, hi).astype(int)
+    return counts
+
+
+def q_of(identity: float) -> float:
+    return 60.0 if identity >= 1.0 else -10.0 * math.log10(1.0 - identity)
+
+
+def _fastq(zs) -> str:
+    out = []
+    for z in zs:
+        for name, p in zip(z.names, z.passes):
+            s = enc.decode(p)
+            out.append(f"@{name}\n{s}\n+\n{'~' * len(s)}\n")
+    return "".join(out)
+
+
+def make_config_input(config, zs, tmp):
+    """Write `zs` in the shape BASELINE config `config` prescribes.
+
+    Configs (BASELINE.json): 1 FASTA shred, 2 BAM defaults, 3 whole-read
+    -P, 4 deep-pass, 5 gzipped FASTQ.  Input format is what varies here;
+    hole composition is the caller's distribution.
+    """
+    if config == 2:
+        p = os.path.join(tmp, "in.bam")
+        recs = [(n, enc.decode(s).encode(), None)
+                for z in zs for n, s in zip(z.names, z.passes)]
+        bam.write_bam(p, recs)
+        return p, []
+    if config == 3:
+        p = os.path.join(tmp, "in.fa")
+        open(p, "w").write(synth.make_fasta(zs))
+        return p, ["-A", "-P"]
+    if config == 5:
+        p = os.path.join(tmp, "in.fq.gz")
+        with gzip.open(p, "wt") as f:
+            f.write(_fastq(zs))
+        return p, ["-A"]
+    p = os.path.join(tmp, "in.fa")   # configs 1 and 4
+    open(p, "w").write(synth.make_fasta(zs))
+    return p, ["-A"]
+
+
+def run_gate_config(config, n_holes, rng, tlen=800):
+    """Q20 yield for one BASELINE config over the pass distribution."""
+    counts = sample_pass_counts(rng, n_holes)
+    if config == 4:   # deep-pass config: 15..30 passes
+        counts = np.clip(counts + 12, 15, 30)
+    zs = [synth.make_zmw(rng, tlen, int(c), movie="mv", hole=str(h), **ERR)
+          for h, c in enumerate(counts)]
+    with tempfile.TemporaryDirectory() as tmp:
+        in_path, args, = make_config_input(config, zs, tmp)
+        out = os.path.join(tmp, "out.fa")
+        rc = cli.main([*args, "-m", "1000", "--batch", "auto", in_path, out])
+        assert rc == 0, f"config {config}: rc={rc}"
+        got = {r.name: r.seq for r in fastx.read_fastx(out)}
+    idys = []
+    for z in zs:
+        k = f"{z.movie}/{z.hole}/ccs"
+        idys.append(synth.identity_either(enc.encode(got[k]), z.template)
+                    if k in got else 0.0)
+    idys = np.array(idys)
+    qs = np.array([q_of(i) for i in idys])
+    return {
+        "config": config,
+        "holes_in": n_holes,
+        "holes_out": int((idys > 0).sum()),
+        "mean_identity": round(float(idys[idys > 0].mean()), 5),
+        "median_q": round(float(np.median(qs)), 2),
+        "q20_yield": round(float((idys >= 0.99).mean()), 4),
+        "pass_counts": [int(c) for c in counts],
+    }
+
+
+def _consensus_identity(z, cfg):
+    """Direct consensus path (no CLI) for sweep configs."""
+    from ccsx_tpu.io.zmw import Zmw
+
+    lens = np.array([len(p) for p in z.passes], np.int32)
+    offs = np.zeros(len(lens), np.int32)
+    if len(lens) > 1:
+        np.cumsum(lens[:-1], out=offs[1:])
+    zz = Zmw(movie=z.movie, hole=z.hole,
+             seqs=enc.decode(np.concatenate(z.passes)).encode(),
+             lens=lens, offs=offs)
+    passes = prep.oriented_passes(zz, HostAligner(cfg.align), cfg)
+    if passes is None:
+        return 0.0
+    cns = consensus_windowed(passes, cfg)
+    return synth.identity_either(cns, z.template)
+
+
+def sweep_max_window(rng, n_holes=4, tlen=6000, err_scale=2.5):
+    """Low-agreement holes: flush-at-max_window vs reference-parity
+    unbounded growth (window_growth="grow"), with the cap tightened
+    (window_init=1024, max_window=2048) so any growth would hit it
+    mid-molecule.
+
+    The sweep counts breakpoint-scan failures (the only trigger of
+    window growth, main.c:550) while consensing three adversarial
+    families: (a) 6-pass holes at ~29% total error, (b) a 3000bp
+    period-5 tandem repeat flanked by unique sequence (classic
+    alignment-slippage case), (c) 3-pass holes at ~40% error.
+    MEASURED RESULT (2026-07-29, recorded in BASELINE.md): zero failures
+    — the star-MSA projects every pass onto common draft coordinates, so
+    column agreement is structural and the breakpoint scan succeeds even
+    where the reference's progressive POA MSA would diverge; the
+    force-flush delta is therefore vacuous in this architecture (modes
+    remain bit-identical), not merely small."""
+    e = {k: min(v * err_scale, 0.12) for k, v in ERR.items()}
+    out = {"holes": n_holes, "tlen": tlen, "err": e,
+           "window_init": 1024, "max_window": 2048}
+
+    from ccsx_tpu.consensus import windowed as win_mod
+
+    counts = {"scans": 0, "no_breakpoint": 0}
+    orig = win_mod.find_breakpoint
+
+    def spy(rr, nseq, cfg):
+        bp = orig(rr, nseq, cfg)
+        counts["scans"] += 1
+        counts["no_breakpoint"] += bp is None
+        return bp
+
+    def holes(r):
+        hs = [synth.make_zmw(r, tlen, 6, movie="mv", hole=str(h), **e)
+              for h in range(n_holes)]
+        motif = r.integers(0, 4, 5).astype(np.uint8)
+        tpl = np.concatenate([
+            r.integers(0, 4, 1500).astype(np.uint8), np.tile(motif, 600),
+            r.integers(0, 4, 1500).astype(np.uint8)])
+        hs.append(synth.make_zmw(r, len(tpl), 6, movie="mv", hole="rep",
+                                 template=tpl, **e))
+        hs.append(synth.make_zmw(r, tlen, 3, movie="mv", hole="x",
+                                 sub_rate=0.10, ins_rate=0.15,
+                                 del_rate=0.15))
+        return hs
+
+    seed = rng.integers(1 << 31)
+    ids = {"flush": [], "grow": []}
+    win_mod.find_breakpoint = spy
+    try:
+        for mode in ("flush", "grow"):
+            cfg = CcsConfig(is_bam=False, min_subread_len=1000,
+                            window_growth=mode, window_init=1024,
+                            window_add=1024, max_window=2048)
+            for z in holes(np.random.default_rng(seed)):
+                ids[mode].append(_consensus_identity(z, cfg))
+    finally:
+        win_mod.find_breakpoint = orig
+    for mode in ("flush", "grow"):
+        a = np.array(ids[mode])
+        out[f"identity_{mode}"] = round(float(a.mean()), 5)
+        out[f"q20_yield_{mode}"] = round(float((a >= 0.99).mean()), 4)
+    out["delta_identity"] = round(
+        out["identity_grow"] - out["identity_flush"], 5)
+    out["breakpoint_scans"] = counts["scans"]
+    out["no_breakpoint_events"] = counts["no_breakpoint"]
+    return out
+
+
+def sweep_max_passes(rng, n_holes=3, tlen=1200, deep=48):
+    """40-60-pass holes: max_passes=32 cap vs all passes."""
+    out = {"holes": n_holes, "tlen": tlen, "passes": deep}
+    ids = {32: [], deep: []}
+    for h in range(n_holes):
+        z = synth.make_zmw(rng, tlen, deep, movie="mv", hole=str(h), **ERR)
+        for cap in (32, deep):
+            cfg = CcsConfig(is_bam=False, min_subread_len=1000,
+                            max_passes=cap,
+                            pass_buckets=(4, 8, 16, 32, 64))
+            ids[cap].append(_consensus_identity(z, cfg))
+    for cap in (32, deep):
+        a = np.array(ids[cap])
+        out[f"identity_cap{cap}"] = round(float(a.mean()), 5)
+    out["delta_identity"] = round(
+        out[f"identity_cap{deep}"] - out["identity_cap32"], 5)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--holes", type=int, default=12)
+    ap.add_argument("--json", default=None)
+    ap.add_argument("--full", action="store_true",
+                    help="heavier sweeps (more holes)")
+    ap.add_argument("--device", default="auto",
+                    choices=["auto", "tpu", "cpu"])
+    a = ap.parse_args()
+
+    from ccsx_tpu.utils.device import resolve_device
+
+    resolve_device(a.device)
+    import jax
+
+    rng = np.random.default_rng(7)
+    res = {"backend": jax.default_backend(), "q20_definition":
+           "identity >= 0.99 (global alignment vs template, "
+           "better orientation)"}
+    res["gate"] = [run_gate_config(c, a.holes, rng) for c in (1, 2, 3, 4, 5)]
+    res["sweep_max_window"] = sweep_max_window(
+        rng, n_holes=8 if a.full else 4)
+    res["sweep_max_passes"] = sweep_max_passes(
+        rng, n_holes=6 if a.full else 3)
+    print(json.dumps(res, indent=1))
+    if a.json:
+        with open(a.json, "w") as f:
+            json.dump(res, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -44,6 +44,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--device", default="auto", choices=["auto", "tpu", "cpu"])
     p.add_argument("--refine-iters", type=int, default=2)
     p.add_argument("--max-passes", type=int, default=32)
+    p.add_argument("--window-growth", default="flush",
+                   choices=["flush", "grow"],
+                   help="When no breakpoint is found at max-window: "
+                        "'flush' forces a flush (bounded kernel shapes), "
+                        "'grow' keeps growing like the reference [flush]")
     p.add_argument("--batch", default="auto",
                    choices=["auto", "on", "off"],
                    help="Batched device pipeline: many holes per TPU "
@@ -91,6 +96,7 @@ def config_from_args(args) -> CcsConfig:
         verbose=args.verbose,
         refine_iters=args.refine_iters,
         max_passes=args.max_passes,
+        window_growth=args.window_growth,
         device=args.device,
         metrics_path=args.metrics,
     )

@@ -60,6 +60,10 @@ class CcsConfig:
     window_add: int = 2048             # reference addlen=2000
     window_minlen: int = 1024          # reference minlen=1000: min tail beyond window
     max_window: int = 8192             # growth cap before force-flush (TPU memory bound)
+    window_growth: str = "flush"       # at max_window: "flush" force-flushes a
+    #   breakpoint (bounded shapes; documented delta), "grow" keeps growing like
+    #   the reference's unbounded window (main.c:550,613-616) — geometric length
+    #   buckets keep the compile count logarithmic, so parity mode stays viable
 
     # ---- consensus redesign knobs (no reference equivalent) ----
     refine_iters: int = 2              # realign-to-draft refinement rounds;

@@ -6,10 +6,14 @@ main.c:255-290) and the static-shape device DP: k-mer diagonal seeding
 quantized shapes so XLA compilations are reused, and the acceptance rule is
 the reference's (main.c:280).
 
-This is the scalar (one pair per dispatch) path used by prepare.  Measured
-at ~2ms/hole on CPU it is far from the prep bottleneck at current chunk
-sizes; if prep ever exceeds ~10% of wall time at device-round speed, the
-fix is batching these pair alignments through the same padded buckets.
+This is the scalar (one pair per dispatch) path used by the per-hole
+pipeline and sync callers.  Measured 2026-07-29 (benchmarks/prep_share.py):
+one-pair-per-dispatch prep would be ~95% of wall time at device-round
+speed, so the batched pipeline routes these same pair alignments through
+pipeline/batch.PairExecutor instead — PairRequests from many holes'
+prepare generators are stacked into padded-bucket batched local fills
+(measured 4.5x faster on v5e at 64 pairs, bit-identical accept/clip
+results).  This class remains the spec the executor must agree with.
 """
 
 from __future__ import annotations

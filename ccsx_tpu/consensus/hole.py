@@ -26,11 +26,7 @@ def _traced(gen, tag: str):
     return result
 
 
-def consensus_gen_for_zmw(zmw, aligner, cfg: CcsConfig):
-    """The consensus generator for one hole, or None if it is skipped."""
-    passes = prep.oriented_passes(zmw, aligner, cfg)
-    if passes is None:
-        return None
+def _consensus_gen_for_passes(passes, zmw, cfg: CcsConfig):
     if cfg.split_subread:
         gen = windowed_gen(passes, cfg)
     else:
@@ -40,6 +36,36 @@ def consensus_gen_for_zmw(zmw, aligner, cfg: CcsConfig):
     if cfg.verbose >= 2:
         gen = _traced(gen, f"{zmw.movie}/{zmw.hole}")
     return gen
+
+
+def consensus_gen_for_zmw(zmw, aligner, cfg: CcsConfig):
+    """The consensus generator for one hole, or None if it is skipped.
+    Prep runs synchronously here (per-pair dispatches via `aligner`); the
+    batched pipeline uses full_gen_for_zmw instead."""
+    passes = prep.oriented_passes(zmw, aligner, cfg)
+    if passes is None:
+        return None
+    return _consensus_gen_for_passes(passes, zmw, cfg)
+
+
+def full_gen_for_zmw(zmw, cfg: CcsConfig):
+    """Combined prep + consensus generator for one hole.
+
+    Yields prepare.PairRequest during the orientation walk, then
+    star.RoundRequest during consensus (the driver dispatches on type,
+    batching each across holes); returns the consensus codes (or None
+    for a skipped hole) via StopIteration.value.
+    """
+    if zmw.n_passes < 3:  # main.c:460,515
+        return None
+    from ccsx_tpu.ops import encode as enc_mod
+
+    codes = enc_mod.encode(zmw.seqs)
+    segments = yield from prep.ccs_prepare_gen(codes, zmw.lens, zmw.offs,
+                                               cfg)
+    passes = prep.passes_from_segments(codes, segments, zmw, cfg)
+    result = yield from _consensus_gen_for_passes(passes, zmw, cfg)
+    return result
 
 
 def _counted(gen, stats: dict):

@@ -108,12 +108,26 @@ class Segment:
     pos: int = 0
 
 
-def get_template_grp(codes: np.ndarray, lens, offs, groups: List[LenGroup],
-                     aligner, cfg: CcsConfig) -> int:
+@dataclasses.dataclass
+class PairRequest:
+    """One strand_match pair alignment (main.c:255-290), requested by a
+    prep generator.  The per-hole path satisfies these immediately via
+    HostAligner.strand_match; the batched pipeline stacks pairs from many
+    holes into padded-bucket device dispatches (pipeline/batch.py
+    PairExecutor) — prep measured ~95% of wall time at device-round speed
+    when dispatched one pair at a time (benchmarks/prep_share.py)."""
+
+    q: np.ndarray
+    t: np.ndarray
+    pct: int
+
+
+def _template_grp_gen(codes: np.ndarray, lens, offs, groups: List[LenGroup],
+                      cfg: CcsConfig):
     """Template-group adjustment rejecting palindrome/adapter artifacts
     (main.c:300-342): a larger-length candidate group is adopted unless the
     reverse-complement of either 1000bp border matches the rest of the read
-    at 70% identity."""
+    at 70% identity.  Yields PairRequests; receives (ok, MatchResult)."""
     template_grp = 0
     if groups[0].size < 2:
         return 0
@@ -131,19 +145,23 @@ def get_template_grp(codes: np.ndarray, lens, offs, groups: List[LenGroup],
         start = int(offs[ci])
         read = codes[start:start + clen]
         head_rc = enc.revcomp_codes(read[:bl])
-        if aligner.strand_match(head_rc, read[bl:], cfg.border_identity_pct)[0]:
+        ok, _ = yield PairRequest(head_rc, read[bl:],
+                                  cfg.border_identity_pct)
+        if ok:
             continue  # palindromic head: artifact, keep current template
         tail_rc = enc.revcomp_codes(read[clen - bl:])
-        if aligner.strand_match(tail_rc, read[:clen - bl],
-                                cfg.border_identity_pct)[0]:
+        ok, _ = yield PairRequest(tail_rc, read[:clen - bl],
+                                  cfg.border_identity_pct)
+        if ok:
             continue
         template_grp = cg
     return template_grp
 
 
-def ccs_prepare(codes: np.ndarray, lens, offs, aligner,
-                cfg: CcsConfig) -> List[Segment]:
-    """The outward orientation walk (ccs_prepare, main.c:344-453).
+def ccs_prepare_gen(codes: np.ndarray, lens, offs, cfg: CcsConfig):
+    """The outward orientation walk (ccs_prepare, main.c:344-453), in
+    generator form: yields PairRequests, receives (ok, MatchResult),
+    returns the segment list via StopIteration.value.
 
     Starting from the template pass, walk outward in both directions,
     alternating the expected strand each step.  In-group passes are trusted
@@ -159,7 +177,8 @@ def ccs_prepare(codes: np.ndarray, lens, offs, aligner,
         for i in g.ids:
             map_group[i] = gi
 
-    template_grp = get_template_grp(codes, lens, offs, groups, aligner, cfg)
+    template_grp = yield from _template_grp_gen(codes, lens, offs, groups,
+                                                cfg)
     tg = groups[template_grp]
     template_i = tg.ids[tg.size // 2]
     template_offs = int(offs[template_i])
@@ -183,12 +202,13 @@ def ccs_prepare(codes: np.ndarray, lens, offs, aligner,
                 segments.append(seg)
                 continue
             qseq = codes[seg.offs:seg.offs + seg.length]
-            ok_f, rs = aligner.strand_match(qseq, tseq, cfg.strand_identity_pct)
+            ok_f, rs = yield PairRequest(qseq, tseq,
+                                         cfg.strand_identity_pct)
             if ok_f:
                 reverse = False
             else:
-                ok_r, rs = aligner.strand_match(qseq, t2seq,
-                                                cfg.strand_identity_pct)
+                ok_r, rs = yield PairRequest(qseq, t2seq,
+                                             cfg.strand_identity_pct)
                 if ok_r:
                     reverse = True
                 else:
@@ -199,9 +219,50 @@ def ccs_prepare(codes: np.ndarray, lens, offs, aligner,
                 segments.append(clipped)
             strand_adjust = map_group[k] != template_grp
 
-    walk(range(template_i - 1, -1, -1))
-    walk(range(template_i + 1, len(lens)))
+    yield from walk(range(template_i - 1, -1, -1))
+    yield from walk(range(template_i + 1, len(lens)))
     return segments
+
+
+def drive_pairs(gen, aligner):
+    """Run a PairRequest generator to completion with immediate
+    (per-pair) strand_match dispatches; returns its result."""
+    try:
+        req = next(gen)
+        while True:
+            req = gen.send(aligner.strand_match(req.q, req.t, req.pct))
+    except StopIteration as e:
+        return e.value
+
+
+def get_template_grp(codes: np.ndarray, lens, offs, groups: List[LenGroup],
+                     aligner, cfg: CcsConfig) -> int:
+    """Synchronous wrapper of _template_grp_gen (kept for tests/tools)."""
+    return drive_pairs(
+        _template_grp_gen(codes, lens, offs, groups, cfg), aligner)
+
+
+def ccs_prepare(codes: np.ndarray, lens, offs, aligner,
+                cfg: CcsConfig) -> List[Segment]:
+    """Synchronous ccs_prepare: drives ccs_prepare_gen with immediate
+    per-pair dispatches (the per-hole path; batched path uses the
+    generator directly)."""
+    return drive_pairs(ccs_prepare_gen(codes, lens, offs, cfg), aligner)
+
+
+def passes_from_segments(codes: np.ndarray, segments: List[Segment],
+                         zmw, cfg) -> List[np.ndarray]:
+    """Segment dump (-v level 1, main.c:477-479,533-535) + oriented pass
+    slicing — the tail of prep shared by the sync (oriented_passes) and
+    batched (hole.full_gen_for_zmw) paths, factored so they can't drift."""
+    if cfg.verbose >= 1:
+        import sys
+
+        for s in segments:
+            print(f"[ccsx-tpu] {zmw.movie}/{zmw.hole} segment "
+                  f"offs={s.offs} len={s.length} reverse={int(s.reverse)}",
+                  file=sys.stderr)
+    return [oriented_pass(codes, s) for s in segments]
 
 
 def oriented_passes(zmw, aligner, cfg):
@@ -212,19 +273,9 @@ def oriented_passes(zmw, aligner, cfg):
     """
     if zmw.n_passes < 3:
         return None
-    from ccsx_tpu.ops import encode as enc
-
     codes = enc.encode(zmw.seqs)
     segments = ccs_prepare(codes, zmw.lens, zmw.offs, aligner, cfg)
-    if cfg.verbose >= 1:
-        # segment dump, the reference's -v level 1 (main.c:477-479,533-535)
-        import sys
-
-        for s in segments:
-            print(f"[ccsx-tpu] {zmw.movie}/{zmw.hole} segment "
-                  f"offs={s.offs} len={s.length} reverse={int(s.reverse)}",
-                  file=sys.stderr)
-    return [oriented_pass(codes, s) for s in segments]
+    return passes_from_segments(codes, segments, zmw, cfg)
 
 
 def oriented_pass(codes: np.ndarray, seg: Segment) -> np.ndarray:

@@ -124,12 +124,19 @@ def windowed_gen(passes: List[np.ndarray], cfg: CcsConfig):
 
                 print(f"[ccsx-tpu] window size={window_size} "
                       f"msa_cols={rr.tlen} breakpoint={bp}", file=sys.stderr)
-            if bp is None and window_size + cfg.window_add <= cfg.max_window:
+            if bp is None and (
+                    cfg.window_growth == "grow"
+                    or window_size + cfg.window_add <= cfg.max_window):
+                # no breakpoint: grow the window (main.c:550).  In "grow"
+                # mode this is unbounded like the reference — the fits
+                # check above flushes the tails once the window spans the
+                # remaining pass lengths, exactly as main.c:555-564 does
                 window_size += cfg.window_add
                 continue
             if bp is None:
                 # growth cap reached: force a flush point (delta vs the
-                # reference's unbounded growth)
+                # reference's unbounded growth; disable via
+                # window_growth="grow")
                 bp = max(rr.tlen - cfg.bp_window, 1)
             out.append(rr.materialize(upto=bp))
             pos += _advance(rr, bp)[:nseq]  # drop pass-bucket padding rows

@@ -35,8 +35,9 @@ import jax
 import numpy as np
 
 from ccsx_tpu.config import AlignParams, CcsConfig
-from ccsx_tpu.consensus.align_host import HostAligner
-from ccsx_tpu.consensus.hole import consensus_gen_for_zmw
+from ccsx_tpu.consensus import prepare as prep_mod
+from ccsx_tpu.consensus.align_host import MatchResult
+from ccsx_tpu.consensus.hole import full_gen_for_zmw
 from ccsx_tpu.consensus.star import (
     RoundRequest, RoundResult, bucket_len, pad_to,
 )
@@ -86,6 +87,91 @@ def _z_bucket(n: int) -> int:
     while z < n:
         z *= 2
     return z
+
+
+@functools.lru_cache(maxsize=8)
+def _pair_fill(params: AlignParams):
+    """Jitted batched local fill with per-pair line hints — the device
+    half of strand_match (main.c:255-290), batched across holes."""
+    from ccsx_tpu.ops import banded as banded_mod
+
+    return banded_mod.make_batched("local", params, with_line=True)
+
+
+class PairExecutor:
+    """Batches prep PairRequests (strand_match pairs) across holes.
+
+    One pair per dispatch leaves prep at ~95% of wall time at device-
+    round speed (benchmarks/prep_share.py); here pairs from many holes
+    are seeded on the host (ops/seed.py), grouped by padded (qmax, tmax)
+    bucket, and filled in ONE batched local-mode banded DP per group —
+    the same shape-bucketing discipline as the consensus rounds.
+    """
+
+    def __init__(self, params: AlignParams, quant: int = 512,
+                 metrics=None):
+        self.params = params
+        self.quant = quant
+        self.metrics = metrics
+
+    def run(self, pairs: List["prep_mod.PairRequest"]):
+        """Satisfy all pair requests; results align index-for-index as
+        (ok, MatchResult) tuples — the strand_match contract."""
+        from ccsx_tpu.ops import seed as seed_mod
+
+        results = [None] * len(pairs)
+        groups: Dict[tuple, List[int]] = defaultdict(list)
+        lines: Dict[int, np.ndarray] = {}
+        for i, pr in enumerate(pairs):
+            hit = seed_mod.seed_diagonal(pr.q, pr.t)
+            if hit is None:
+                # no shared 13-mers: unalignable at >=60% identity
+                results[i] = (False, MatchResult(False, 0, 0, 0, 0, 0, 0, 0))
+                continue
+            if abs(hit.diag) > self.params.band // 4:
+                lines[i] = np.asarray(hit.line, np.int32)
+            else:
+                # near-diagonal: the default corner-to-corner line
+                lines[i] = np.array(
+                    [0, 0, len(pr.q), len(pr.t)], np.int32)
+            groups[(bucket_len(len(pr.q), self.quant),
+                    bucket_len(len(pr.t), self.quant))].append(i)
+
+        if self.metrics is not None:
+            self.metrics.pair_alignments += len(lines)
+            self.metrics.device_dispatches += len(groups)
+        fill = _pair_fill(self.params)
+        for (qmax, tmax), idxs in groups.items():
+            N = _z_bucket(len(idxs))
+            qs = np.stack([pad_to(pairs[i].q, qmax) for i in idxs]
+                          + [pad_to(np.zeros(0, np.uint8), qmax)]
+                          * (N - len(idxs)))
+            ts = np.stack([pad_to(pairs[i].t, tmax) for i in idxs]
+                          + [pad_to(np.zeros(0, np.uint8), tmax)]
+                          * (N - len(idxs)))
+            qlens = np.zeros((N,), np.int32)
+            tlens = np.zeros((N,), np.int32)
+            ls = np.zeros((N, 4), np.int32)
+            for z, i in enumerate(idxs):
+                qlens[z] = len(pairs[i].q)
+                tlens[z] = len(pairs[i].t)
+                ls[z] = lines[i]
+            res = fill(qs, qlens, ts, tlens, ls)
+            score = np.asarray(res.score)
+            qb, qe = np.asarray(res.qb), np.asarray(res.qe)
+            tb, te = np.asarray(res.tb), np.asarray(res.te)
+            aln, mat = np.asarray(res.aln), np.asarray(res.mat)
+            for z, i in enumerate(idxs):
+                rs = MatchResult(
+                    ok=False, score=int(score[z]), qb=int(qb[z]),
+                    qe=int(qe[z]), tb=int(tb[z]), te=int(te[z]),
+                    aln=int(aln[z]), mat=int(mat[z]))
+                pr = pairs[i]
+                # acceptance rule, main.c:280
+                rs.ok = (rs.aln * 2 > min(len(pr.q), len(pr.t))) and (
+                    rs.mat * 100 >= rs.aln * pr.pct)
+                results[i] = (rs.ok, rs)
+        return results
 
 
 class BatchExecutor:
@@ -172,15 +258,14 @@ class _Hole:
     err: Optional[Exception] = None
 
 
-def _start_hole(hole: _Hole, aligner: HostAligner, cfg: CcsConfig) -> None:
-    """Host prep (orientation + clip) and first generator step."""
+def _start_hole(hole: _Hole, cfg: CcsConfig) -> None:
+    """Start the combined prep+consensus generator (first step only;
+    PairRequests and RoundRequests both flow through the driver)."""
     try:
-        hole.gen = consensus_gen_for_zmw(hole.zmw, aligner, cfg)
-        if hole.gen is None:  # main.c:515
-            hole.done = True
-            return
+        hole.gen = full_gen_for_zmw(hole.zmw, cfg)
         hole.req = next(hole.gen)
     except StopIteration as e:
+        # skipped (<3 passes -> None) or consensus without device work
         hole.done, hole.cns = True, _finish(e.value)
     except Exception as e:  # quarantine: one bad hole must not kill the run
         hole.done, hole.err = True, e
@@ -215,8 +300,9 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
     # a non-positive in-flight window would make the admission condition
     # permanently false and spin the scheduler forever
     inflight = max(1, int(inflight))
-    aligner = HostAligner(cfg.align)
     executor = BatchExecutor(cfg, metrics=metrics)
+    pair_executor = PairExecutor(cfg.align, quant=cfg.len_bucket_quant,
+                                 metrics=metrics)
     resume = journal.holes_done
     put_at = getattr(writer, "put_at", None)
 
@@ -269,8 +355,12 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
                 if metrics.holes_in <= resume:
                     h.done = h.resumed = True
                 else:
-                    with metrics.timer("compute"):
-                        _start_hole(h, aligner, cfg)
+                    # prep host work (grouping + first generator step)
+                    # timed as its own stage; the walk's pair alignments
+                    # are batched below (benchmarks/prep_share.py is the
+                    # criterion that forced this)
+                    with metrics.timer("prep"):
+                        _start_hole(h, cfg)
                 if h.done:
                     finished[h.idx] = h
                 else:
@@ -280,17 +370,29 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
                 if exhausted:
                     break
                 continue
-            # one batched device round over every pending request
-            reqs = [h.req for h in active]
-            with metrics.timer("compute"):
-                round_results = executor.run(reqs)
-                still: List[_Hole] = []
-                for h, rr in zip(active, round_results):
-                    _advance_hole(h, rr)
-                    if h.done:
-                        finished[h.idx] = h
-                    else:
-                        still.append(h)
+            # one batched sweep over every pending request, split by
+            # kind: prep pair alignments (strand_match walks) and
+            # consensus rounds each batch across holes
+            pair_holes = [h for h in active
+                          if isinstance(h.req, prep_mod.PairRequest)]
+            round_holes = [h for h in active
+                           if not isinstance(h.req, prep_mod.PairRequest)]
+            if pair_holes:
+                with metrics.timer("prep"):
+                    pres = pair_executor.run([h.req for h in pair_holes])
+                    for h, r in zip(pair_holes, pres):
+                        _advance_hole(h, r)
+            if round_holes:
+                with metrics.timer("compute"):
+                    rres = executor.run([h.req for h in round_holes])
+                    for h, rr in zip(round_holes, rres):
+                        _advance_hole(h, rr)
+            still: List[_Hole] = []
+            for h in active:
+                if h.done:
+                    finished[h.idx] = h
+                else:
+                    still.append(h)
             active = still
             emit_ready()
     except (bam_mod.BamError, zmw_mod.InvalidZmwName, ValueError) as e:

@@ -23,12 +23,14 @@ class Metrics:
     holes_out: int = 0
     holes_failed: int = 0
     windows: int = 0
+    pair_alignments: int = 0   # batched prep strand_match pairs
     device_dispatches: int = 0
     # per-stage wall time (SURVEY.md §5.1: the reference has no stage
     # timing; the pipeline analog of its read/compute/write steps).
     # Attribution is at the driver loop: with worker threads, t_compute
     # is the driver's wall time blocked on compute results.
     t_ingest: float = 0.0
+    t_prep: float = 0.0     # host orientation/clip (ccs_prepare analog)
     t_compute: float = 0.0
     t_write: float = 0.0
     # a "progress" JSONL event is emitted every progress_every retired
@@ -71,8 +73,10 @@ class Metrics:
             "holes_out": self.holes_out,
             "holes_failed": self.holes_failed,
             "windows": self.windows,
+            "pair_alignments": self.pair_alignments,
             "device_dispatches": self.device_dispatches,
             "ingest_s": round(self.t_ingest, 6),
+            "prep_s": round(self.t_prep, 6),
             "compute_s": round(self.t_compute, 6),
             "write_s": round(self.t_write, 6),
             "elapsed_s": round(self.elapsed, 3),
