@@ -1,0 +1,93 @@
+"""BGZF ingest micro-benchmark (SURVEY §7.3 item 6; VERDICT r2 item 7).
+
+Writes a synthetic BGZF subreads.bam and times the native reader's full
+ingest path (block-parallel inflate + BAM record parse + nibble decode)
+at several thread counts, plus Python gzip decompression as a floor
+reference.  Reports uncompressed MB/s.
+
+Usage: python benchmarks/bgzf_bench.py [--mb N] [--json out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from ccsx_tpu.io import bam as bam_mod                       # noqa: E402
+
+
+def make_bam(path, target_mb: int):
+    rng = np.random.default_rng(0)
+    recs = []
+    seqlen = 20000
+    total = 0
+    i = 0
+    while total < target_mb * (1 << 20):
+        seq = rng.choice(list(b"ACGT"), seqlen).astype(np.uint8).tobytes()
+        recs.append((f"mv/{i // 8}/{i}_{i + seqlen}", seq,
+                     b"\x28" * seqlen))
+        total += seqlen
+        i += 1
+    bam_mod.write_bam(path, recs, bgzf=True)
+    return len(recs), total
+
+
+def time_native(path, threads: int):
+    from ccsx_tpu.native.io import read_records_native
+
+    os.environ["CCSX_BGZF_THREADS"] = str(threads)
+    t0 = time.perf_counter()
+    n = 0
+    nbytes = 0
+    for r in read_records_native(path, is_bam=True):
+        n += 1
+        nbytes += len(r.seq)
+    dt = time.perf_counter() - t0
+    del os.environ["CCSX_BGZF_THREADS"]
+    return {"threads": threads, "records": n,
+            "mb_per_s": round(nbytes / dt / (1 << 20), 1),
+            "seconds": round(dt, 3)}
+
+
+def time_python_gzip(path):
+    import gzip
+
+    t0 = time.perf_counter()
+    with gzip.open(path, "rb") as f:
+        n = len(f.read())
+    dt = time.perf_counter() - t0
+    return {"mb_per_s": round(n / dt / (1 << 20), 1),
+            "seconds": round(dt, 3)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=int, default=64)
+    ap.add_argument("--json", default=None)
+    a = ap.parse_args()
+    res = {"uncompressed_mb": a.mb,
+           "host_cores": os.cpu_count()}
+    with tempfile.TemporaryDirectory() as tmp:
+        p = os.path.join(tmp, "bench.bam")
+        nrec, nbytes = make_bam(p, a.mb)
+        res["bam_compressed_mb"] = round(os.path.getsize(p) / (1 << 20), 1)
+        res["python_gzip_inflate_only"] = time_python_gzip(p)
+        for t in (1, 2, 4, 8):
+            res[f"native_t{t}"] = time_native(p, t)
+    print(json.dumps(res, indent=1))
+    if a.json:
+        with open(a.json, "w") as f:
+            json.dump(res, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

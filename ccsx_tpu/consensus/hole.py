@@ -58,9 +58,7 @@ def full_gen_for_zmw(zmw, cfg: CcsConfig):
     """
     if zmw.n_passes < 3:  # main.c:460,515
         return None
-    from ccsx_tpu.ops import encode as enc_mod
-
-    codes = enc_mod.encode(zmw.seqs)
+    codes = enc.encode(zmw.seqs)
     segments = yield from prep.ccs_prepare_gen(codes, zmw.lens, zmw.offs,
                                                cfg)
     passes = prep.passes_from_segments(codes, segments, zmw, cfg)

@@ -101,8 +101,43 @@ def read_bam_records(path_or_file) -> Iterator[FastxRecord]:
         yield FastxRecord(name=name, comment="", seq=seq, qual=qual)
 
 
-def write_bam(path, records, refs=()) -> None:
-    """Tiny BAM writer for tests/fixtures (unmapped records only)."""
+# BGZF framing (the real subreads.bam container): gzip members <=64KB
+# with a "BC" extra subfield holding the compressed block size, ending in
+# a fixed 28-byte empty EOF block.  Valid multi-member gzip, so every
+# plain-gzip reader (incl. this module's read path and the reference's
+# bamlite, bamlite.h:13-19) still reads it; the native reader additionally
+# exploits the block structure for parallel inflate (io_native.cpp).
+BGZF_EOF = bytes.fromhex(
+    "1f8b08040000000000ff0600424302001b0003000000000000000000")
+BGZF_BLOCK_PAYLOAD = 0xFF00      # htslib's default uncompressed chunk
+
+
+def _bgzf_block(data: bytes) -> bytes:
+    import zlib
+
+    co = zlib.compressobj(6, zlib.DEFLATED, -15)
+    comp = co.compress(data) + co.flush()
+    bsize = 18 + len(comp) + 8 - 1          # total block size minus 1
+    header = (b"\x1f\x8b\x08\x04" + b"\x00" * 4 + b"\x00\xff"
+              + struct.pack("<H", 6) + b"BC" + struct.pack("<HH", 2, bsize))
+    return (header + comp + struct.pack("<II", zlib.crc32(data),
+                                        len(data) & 0xFFFFFFFF))
+
+
+def write_bgzf(path, data: bytes) -> None:
+    """Write `data` as a BGZF stream (blocked gzip + EOF marker)."""
+    with open(path, "wb") as fh:
+        for i in range(0, len(data), BGZF_BLOCK_PAYLOAD):
+            fh.write(_bgzf_block(data[i:i + BGZF_BLOCK_PAYLOAD]))
+        fh.write(BGZF_EOF)
+
+
+def write_bam(path, records, refs=(), bgzf: bool = True) -> None:
+    """Tiny BAM writer for tests/fixtures (unmapped records only).
+
+    BGZF container by default, like real subreads.bam; ``bgzf=False``
+    writes one plain gzip member (also valid BAM-through-gzip, and
+    exercises the native reader's non-BGZF fallback)."""
     import zlib
 
     out = io.BytesIO()
@@ -135,5 +170,8 @@ def write_bam(path, records, refs=()) -> None:
         out.write(struct.pack("<i", len(body)))
         out.write(body)
     data = out.getvalue()
-    with open(path, "wb") as fh:
-        fh.write(gzip.compress(data))
+    if bgzf:
+        write_bgzf(path, data)
+    else:
+        with open(path, "wb") as fh:
+            fh.write(gzip.compress(data))

@@ -236,3 +236,119 @@ def test_encode_revcomp_native():
     codes = rng.integers(0, 5, 257).astype(np.uint8)
     np.testing.assert_array_equal(
         revcomp_codes_native(codes), enc.revcomp_codes(codes))
+
+
+# ---- BGZF block-parallel reader (io_native.cpp BgzfMT) --------------------
+
+
+def _mk_records(n=40, seqlen=300):
+    rng = np.random.default_rng(3)
+    out = []
+    for i in range(n):
+        seq = rng.choice(list(b"ACGT"), seqlen).astype(
+            np.uint8).tobytes()
+        out.append((f"mv/{i // 4}/{i}_{i + seqlen}", seq, b"\x20" * seqlen))
+    return out
+
+
+def test_bgzf_equals_plain_gzip_bam(tmp_path):
+    """The BGZF path must produce byte-identical records to the plain
+    single-member gzip path (same BAM payload, different container)."""
+    from ccsx_tpu.native.io import read_records_native
+
+    recs = _mk_records()
+    pb = str(tmp_path / "b.bam")
+    pg = str(tmp_path / "g.bam")
+    bam_mod.write_bam(pb, recs, bgzf=True)
+    bam_mod.write_bam(pg, recs, bgzf=False)
+    a = list(read_records_native(pb, is_bam=True))
+    b = list(read_records_native(pg, is_bam=True))
+    assert [(r.name, r.seq) for r in a] == [(r.name, r.seq) for r in b]
+    assert len(a) == len(recs)
+    # multi-block: the BGZF file must actually contain several members
+    raw = open(pb, "rb").read()
+    assert raw.count(b"\x1f\x8b\x08\x04") >= 2
+
+
+def test_bgzf_readable_by_python_gzip(tmp_path):
+    """BGZF is valid multi-member gzip — the Python fallback reader and
+    the reference's plain-gz approach (bamlite.h:13-19) must still work."""
+    recs = _mk_records(n=12)
+    p = str(tmp_path / "b.bam")
+    bam_mod.write_bam(p, recs, bgzf=True)
+    got = list(bam_mod.read_bam_records(p))
+    assert [r.name for r in got] == [r[0] for r in recs]
+
+
+def test_bgzf_corrupt_block_raises(tmp_path):
+    """A flipped byte inside a BGZF member must fail the CRC check."""
+    from ccsx_tpu.native.io import NativeStreamError, read_records_native
+
+    recs = _mk_records(n=20)
+    p = str(tmp_path / "b.bam")
+    bam_mod.write_bam(p, recs, bgzf=True)
+    raw = bytearray(open(p, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF  # middle of some block's payload
+    open(p, "wb").write(bytes(raw))
+    with pytest.raises(NativeStreamError):
+        list(read_records_native(p, is_bam=True))
+
+
+def test_bgzf_truncated_mid_block_raises(tmp_path):
+    from ccsx_tpu.native.io import NativeStreamError, read_records_native
+
+    recs = _mk_records(n=20)
+    p = str(tmp_path / "b.bam")
+    bam_mod.write_bam(p, recs, bgzf=True)
+    raw = open(p, "rb").read()
+    open(p, "wb").write(raw[: len(raw) // 2 + 7])
+    with pytest.raises(NativeStreamError):
+        list(read_records_native(p, is_bam=True))
+
+
+def test_bgzf_threaded_matches_inline(tmp_path, monkeypatch):
+    """CCSX_BGZF_THREADS=4 (pool) and =1 (inline inflate) must agree."""
+    from ccsx_tpu.native.io import read_records_native
+
+    recs = _mk_records(n=60)
+    p = str(tmp_path / "b.bam")
+    bam_mod.write_bam(p, recs, bgzf=True)
+    monkeypatch.setenv("CCSX_BGZF_THREADS", "1")
+    a = [(r.name, r.seq) for r in read_records_native(p, is_bam=True)]
+    monkeypatch.setenv("CCSX_BGZF_THREADS", "4")
+    b = [(r.name, r.seq) for r in read_records_native(p, is_bam=True)]
+    assert a == b and len(a) == 60
+
+
+def test_bgzf_truncated_at_block_boundary_raises(tmp_path):
+    """A BGZF file cut exactly at a member boundary (EOF marker missing)
+    must error, not report a clean shorter stream."""
+    from ccsx_tpu.native.io import NativeStreamError, read_records_native
+
+    recs = _mk_records(n=40)
+    p = str(tmp_path / "b.bam")
+    bam_mod.write_bam(p, recs, bgzf=True)
+    raw = open(p, "rb").read()
+    # drop the trailing EOF marker (28 bytes) only: block-aligned cut
+    assert raw.endswith(bam_mod.BGZF_EOF)
+    open(p, "wb").write(raw[: -len(bam_mod.BGZF_EOF)])
+    with pytest.raises(NativeStreamError):
+        list(read_records_native(p, is_bam=True))
+
+
+def test_bgzf_huge_isize_rejected(tmp_path):
+    """A corrupt ISIZE (> 64KB cap) must be a stream error, not a
+    multi-GB allocation."""
+    from ccsx_tpu.native.io import NativeStreamError, read_records_native
+
+    recs = _mk_records(n=8)
+    p = str(tmp_path / "b.bam")
+    bam_mod.write_bam(p, recs, bgzf=True)
+    raw = bytearray(open(p, "rb").read())
+    # first member: header 18 bytes + payload + crc(4) + isize(4);
+    # BSIZE at offset 16 gives the member size
+    bsize = int.from_bytes(raw[16:18], "little") + 1
+    raw[bsize - 4: bsize] = (0xFFFFFFFF).to_bytes(4, "little")
+    open(p, "wb").write(bytes(raw))
+    with pytest.raises(NativeStreamError):
+        list(read_records_native(p, is_bam=True))
