@@ -15,11 +15,51 @@ from typing import Optional
 from ccsx_tpu.config import CcsConfig
 
 
+USAGE = """\
+Program: ccsx-tpu
+Version: 1.0.0
+Usage  : ccsx-tpu  [options] <INPUT> <OUTPUT>
+Generate circular consensus sequences (ccs) from subreads.
+
+Options:
+-h             Output this help
+-v             debug
+-m     <int>   Minimum total length of subreads in a hole to use for generating CCS. [5000]
+-M     <int>   Maximum total length of subreads in a hole to use for generating CCS. [500000]
+-c     <int>   Minimum number of subreads required to generate CCS. [3]
+-A             For fasta/fastq input,gzip allowed
+-P             primitive bsalign,subread shred by default
+-X\t\t<str>   Exclude ZMWs from output file,a comma-separated list of ID
+-j     <int>   Number of threads to use. [2]
+
+Arguments:
+input          Input file.
+output         Output file.
+
+TPU extensions (long options):
+--device {auto,tpu,cpu}   --batch {auto,on,off}   --inflight <int>
+--refine-iters <int>      --max-passes <int>      --window-growth {flush,grow}
+--journal <path>          --metrics <path>        --profile <dir>
+--hosts <int> --host-id <int> --coordinator <addr> --merge-shards <N>
+"""
+
+
+def usage() -> int:
+    """Reference-parity help text (usage(), main.c:723-749), incl. its
+    quirk: the usage text claims `-j [2]` while the code default is 1
+    (main.c:740 vs main.c:754) — reproduced faithfully; our default is
+    1 like the reference's code.  Returns 1 like the reference."""
+    print(USAGE, end="")
+    return 1
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="ccsx-tpu",
         description="Generate circular consensus sequences (ccs) from subreads.",
+        add_help=False,
     )
+    p.add_argument("-h", "--help", action="store_true", dest="help")
     p.add_argument("input", nargs="?", default="-",
                    help="Input file (BAM, or FASTA/Q with -A); '-' = stdin")
     p.add_argument("output", nargs="?", default="-",
@@ -104,6 +144,8 @@ def config_from_args(args) -> CcsConfig:
 
 def main(argv: Optional[list] = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.help:
+        return usage()  # rc 1, like the reference (main.c:761)
     try:
         cfg = config_from_args(args)
     except SystemExit as e:

@@ -59,24 +59,49 @@ def read_bam_header(f) -> dict:
     return {"text": text, "refs": refs}
 
 
-def read_bam_records(path_or_file) -> Iterator[FastxRecord]:
-    """Stream BAM alignment records as FastxRecords (name/seq/qual)."""
+def read_bam_records(path_or_file, with_aux: bool = False):
+    """Stream BAM alignment records as FastxRecords (name/seq/qual).
+
+    With ``with_aux``, yields (FastxRecord, aux_dict) pairs instead,
+    where aux_dict is parse_aux of the record's tag region
+    (bamlite.c:215-290 equivalent; ccsx's hot path never reads tags)."""
+    bgzf_path = None
     if hasattr(path_or_file, "read"):
         raw = path_or_file
     else:
         raw = open(path_or_file, "rb")
+        bgzf_path = path_or_file
     # transparent gzip/BGZF
     if not hasattr(raw, "peek"):
         raw = io.BufferedReader(raw)
     if raw.peek(2)[:2] == b"\x1f\x8b":
+        if bgzf_path is not None and raw.peek(14)[12:14] != b"BC":
+            bgzf_path = None    # plain gzip, no EOF-marker contract
         f = io.BufferedReader(gzip.GzipFile(fileobj=raw))
     else:
         f = raw
+        bgzf_path = None
+
+    def check_eof_marker():
+        # a BGZF file must end with the 28-byte empty EOF block; a file
+        # cut exactly at a member boundary otherwise reads as a clean
+        # (shorter) stream.  Same check as the native reader (BgzfMT),
+        # so pipeline behavior doesn't depend on which backend loaded.
+        if bgzf_path is None:
+            return
+        with open(bgzf_path, "rb") as fh:
+            fh.seek(0, 2)
+            size = fh.tell()
+            fh.seek(max(0, size - len(BGZF_EOF)))
+            if fh.read() != BGZF_EOF:
+                raise BamError("BGZF stream missing EOF marker "
+                               "(truncated at a block boundary?)")
 
     read_bam_header(f)
     while True:
         head = f.read(4)
         if len(head) == 0:
+            check_eof_marker()
             return  # clean EOF (bamlite.c:141 returns -1)
         if len(head) < 4:
             raise BamError("truncated BAM: partial block size")
@@ -98,7 +123,104 @@ def read_bam_records(path_or_file) -> Iterator[FastxRecord]:
         # phred+33 clamped at 126 (seqio.h:113)
         qual = np.minimum(qual_raw.astype(np.int16) + 33, 126).astype(
             np.uint8).tobytes()
-        yield FastxRecord(name=name, comment="", seq=seq, qual=qual)
+        rec = FastxRecord(name=name, comment="", seq=seq, qual=qual)
+        if with_aux:
+            yield rec, parse_aux(block[off + l_seq:])
+        else:
+            yield rec
+
+
+# ---- aux-tag walk (bamlite.c:215-290) ------------------------------------
+#
+# ccsx itself never reads aux tags, but bamlite ships the full walk +
+# typed getters; parity keeps them available (real subreads.bam carries
+# np/rq/sn/... tags a downstream user may want).
+
+_AUX_SCALAR = {"c": "<b", "C": "<B", "s": "<h", "S": "<H",
+               "i": "<i", "I": "<I", "f": "<f", "d": "<d"}
+
+
+def parse_aux(buf: bytes) -> dict:
+    """Walk an alignment record's aux region into {tag: (type, value)}.
+
+    Mirrors bam_aux_get/skip_aux (bamlite.c:192-241): scalar types
+    c/C/s/S/i/I/f/d, char A, NUL-terminated Z/H, and B arrays."""
+    out = {}
+    off, n = 0, len(buf)
+    try:
+        while off + 3 <= n:
+            tag = buf[off:off + 2].decode("ascii", errors="replace")
+            typ = chr(buf[off + 2])
+            off += 3
+            if typ in _AUX_SCALAR:
+                fmt = _AUX_SCALAR[typ]
+                val = struct.unpack_from(fmt, buf, off)[0]
+                off += struct.calcsize(fmt)
+            elif typ == "A":
+                val = chr(buf[off])
+                off += 1
+            elif typ in "ZH":
+                end = buf.index(b"\x00", off)
+                val = buf[off:end].decode(errors="replace")
+                off = end + 1
+            elif typ == "B":
+                sub = chr(buf[off])
+                (cnt,) = struct.unpack_from("<i", buf, off + 1)
+                if sub not in _AUX_SCALAR:
+                    raise BamError(f"bad B-array sub-type {sub!r}")
+                fmt = _AUX_SCALAR[sub]
+                size = struct.calcsize(fmt)
+                off += 5
+                # a negative/oversized count is corruption; without the
+                # guard `off += cnt * size` could walk backwards and
+                # loop forever
+                if cnt < 0 or off + cnt * size > n:
+                    raise BamError(f"bad B-array count {cnt} for {tag}")
+                val = [struct.unpack_from(fmt, buf, off + i * size)[0]
+                       for i in range(cnt)]
+                off += cnt * size
+            else:
+                raise BamError(f"unknown aux type {typ!r} for tag {tag}")
+            out[tag] = (typ, val)
+    except (ValueError, IndexError, struct.error) as e:
+        if isinstance(e, BamError):
+            raise
+        raise BamError(f"corrupt aux data: {e}") from e
+    return out
+
+
+def _aux_tv(aux: dict, tag: str):
+    return aux.get(tag, ("", None))
+
+
+def aux2i(aux: dict, tag: str) -> int:
+    """Integer getter: c/C/s/S/i/I else 0 (bam_aux2i, bamlite.c:243-252)."""
+    typ, val = _aux_tv(aux, tag)
+    return int(val) if typ in tuple("cCsSiI") else 0
+
+
+def aux2f(aux: dict, tag: str) -> float:
+    """Float getter: f else 0.0 (bam_aux2f, bamlite.c:254-260)."""
+    typ, val = _aux_tv(aux, tag)
+    return float(val) if typ == "f" else 0.0
+
+
+def aux2d(aux: dict, tag: str) -> float:
+    """Double getter: d else 0.0 (bam_aux2d, bamlite.c:262-268)."""
+    typ, val = _aux_tv(aux, tag)
+    return float(val) if typ == "d" else 0.0
+
+
+def aux2A(aux: dict, tag: str) -> str:
+    """Char getter: A else '\\0' (bam_aux2A, bamlite.c:270-276)."""
+    typ, val = _aux_tv(aux, tag)
+    return val if typ == "A" else "\x00"
+
+
+def aux2Z(aux: dict, tag: str):
+    """String getter: Z/H else None (bam_aux2Z, bamlite.c:278-285)."""
+    typ, val = _aux_tv(aux, tag)
+    return val if typ in ("Z", "H") else None
 
 
 # BGZF framing (the real subreads.bam container): gzip members <=64KB
@@ -152,7 +274,9 @@ def write_bam(path, records, refs=(), bgzf: bool = True) -> None:
         out.write(nm)
         out.write(struct.pack("<i", ln))
     rev = {v: i for i, v in enumerate(SEQ_NT16)}
-    for name, seq, qual in records:
+    for rec in records:
+        name, seq, qual = rec[:3]
+        aux = rec[3] if len(rec) > 3 else ()   # (tag, type, value) triples
         nm = name.encode() + b"\x00"
         l_seq = len(seq)
         packed = bytearray((l_seq + 1) // 2)
@@ -167,6 +291,16 @@ def write_bam(path, records, refs=(), bgzf: bool = True) -> None:
         body = struct.pack("<iiBBHHHiiii", -1, -1, len(nm), 255, 0, 0, 4,
                            l_seq, -1, -1, 0)
         body += nm + bytes(packed) + q
+        for tag, typ, val in aux:
+            body += tag.encode("ascii") + typ.encode("ascii")
+            if typ in _AUX_SCALAR:
+                body += struct.pack(_AUX_SCALAR[typ], val)
+            elif typ == "A":
+                body += val.encode("ascii")[:1]
+            elif typ in "ZH":
+                body += val.encode() + b"\x00"
+            else:
+                raise BamError(f"unsupported aux write type {typ!r}")
         out.write(struct.pack("<i", len(body)))
         out.write(body)
     data = out.getvalue()

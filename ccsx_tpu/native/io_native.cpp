@@ -103,7 +103,10 @@ struct BgzfMT {
 
   static int env_threads() {
     const char* e = getenv("CCSX_BGZF_THREADS");
-    if (e && *e) return std::max(1, atoi(e));
+    // clamp explicit values too: an absurd count would throw
+    // std::system_error from thread creation with no handler across
+    // the ctypes boundary (std::terminate)
+    if (e && *e) return std::min(std::max(1, atoi(e)), 8);
     unsigned hc = std::thread::hardware_concurrency();
     return hc > 1 ? (int)std::min(hc, 8u) : 1;
   }

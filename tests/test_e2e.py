@@ -212,3 +212,17 @@ def test_windowed_partial_end_passes(rng):
     cns = windowed.ccs_windowed(zz, HostAligner(cfg.align), cfg)
     idy = synth.identity_either(enc.encode(cns), z.template)
     assert idy > 0.97
+
+
+def test_usage_text_parity(capsys):
+    """-h prints the reference-parity usage (main.c:723-749) and rc 1,
+    including the -j [2] usage-vs-default quirk (main.c:740 vs 754)."""
+    from ccsx_tpu import cli
+
+    assert cli.main(["-h"]) == 1
+    out = capsys.readouterr().out
+    assert "Usage  : ccsx-tpu  [options] <INPUT> <OUTPUT>" in out
+    assert "Number of threads to use. [2]" in out  # the quirk, verbatim
+    assert "Minimum number of subreads required to generate CCS. [3]" in out
+    # the actual default stays 1, like the reference's code (main.c:754)
+    assert cli.build_parser().parse_args(["x", "y"]).threads == 1

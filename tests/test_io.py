@@ -124,3 +124,63 @@ def test_plus_line_after_fasta_record():
     recs = list(fastx.read_fastx(_io.BytesIO(data)))
     assert [r.name for r in recs] == ["r/1/0_4", "r/2/0_4"]
     assert recs[0].qual is None  # quality consumed but not reported for FASTA
+
+
+def test_aux_tag_roundtrip(tmp_path):
+    """Aux-tag walk + typed getters (bamlite.c:215-290 parity)."""
+    from ccsx_tpu.io import bam as bam_mod
+
+    p = str(tmp_path / "aux.bam")
+    aux = [("np", "i", 12), ("rq", "f", 0.5), ("qs", "s", -7),
+           ("RG", "Z", "movie1"), ("fl", "A", "F")]
+    bam_mod.write_bam(p, [("mv/1/0_8", b"ACGTACGT", b"\x10" * 8, aux)])
+    ((rec, tags),) = list(bam_mod.read_bam_records(p, with_aux=True))
+    assert rec.name == "mv/1/0_8"
+    assert bam_mod.aux2i(tags, "np") == 12
+    assert bam_mod.aux2i(tags, "qs") == -7
+    assert abs(bam_mod.aux2f(tags, "rq") - 0.5) < 1e-6
+    assert bam_mod.aux2Z(tags, "RG") == "movie1"
+    assert bam_mod.aux2A(tags, "fl") == "F"
+    # wrong-type / missing gets mirror bamlite's 0/NULL returns
+    assert bam_mod.aux2i(tags, "RG") == 0
+    assert bam_mod.aux2f(tags, "np") == 0.0
+    assert bam_mod.aux2Z(tags, "np") is None
+    assert bam_mod.aux2i(tags, "zz") == 0
+    # records with aux still parse on the no-aux path and native reader
+    (rec2,) = list(bam_mod.read_bam_records(p))
+    assert rec2.seq == rec.seq
+
+
+def test_parse_aux_corrupt_does_not_hang(tmp_path):
+    """Corrupt aux bytes raise BamError (never loop or leak raw errors)."""
+    import struct
+
+    from ccsx_tpu.io import bam as bam_mod
+
+    # negative B-array count (would walk the offset backwards)
+    bad = b"AB" + b"B" + b"c" + struct.pack("<i", -8)
+    with pytest.raises(bam_mod.BamError):
+        bam_mod.parse_aux(bad)
+    # Z tag missing its NUL terminator
+    with pytest.raises(bam_mod.BamError):
+        bam_mod.parse_aux(b"RG" + b"Z" + b"no-nul")
+    # truncated scalar
+    with pytest.raises(bam_mod.BamError):
+        bam_mod.parse_aux(b"np" + b"i" + b"\x01")
+    # good B array still parses
+    good = b"sn" + b"B" + b"C" + struct.pack("<i", 3) + bytes([1, 2, 3])
+    assert bam_mod.parse_aux(good)["sn"] == ("B", [1, 2, 3])
+
+
+def test_python_reader_checks_bgzf_eof_marker(tmp_path):
+    """Python fallback agrees with the native reader on block-boundary
+    truncation (missing EOF marker -> error, not a silent short read)."""
+    from ccsx_tpu.io import bam as bam_mod
+
+    p = str(tmp_path / "b.bam")
+    bam_mod.write_bam(p, [("mv/1/0_4", b"ACGT", b"\x10" * 4)])
+    raw = open(p, "rb").read()
+    assert raw.endswith(bam_mod.BGZF_EOF)
+    open(p, "wb").write(raw[: -len(bam_mod.BGZF_EOF)])
+    with pytest.raises(bam_mod.BamError):
+        list(bam_mod.read_bam_records(p))
