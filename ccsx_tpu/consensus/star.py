@@ -143,17 +143,26 @@ def run_rounds(gen, sm: "StarMsa"):
 
 @dataclasses.dataclass
 class RoundResult:
-    """Device arrays from one star-MSA round (draft coordinates)."""
+    """Device arrays from one star-MSA round (draft coordinates).
+
+    The per-hole path fills every field (host breakpoint scan needs the
+    per-pass tensors).  The batched pipeline computes the breakpoint and
+    cursor advance ON DEVICE (ops/breakpoint.py) and transfers only the
+    small fields, leaving match/aligned/ins_cnt/lead_ins as None and
+    setting bp/advance instead — consumers must branch on bp (the
+    windowed generator does)."""
 
     cons: np.ndarray      # (T,) uint8: 0-3 base, 4 gap
     ins_base: np.ndarray  # (T, R) uint8 majority inserted base per slot/rank
     ins_votes: np.ndarray  # (T, R) int32 supporting passes per slot/rank
     ncov: np.ndarray      # (T,) int32 covering passes
-    match: np.ndarray     # (P, T) bool: pass matches consensus
-    aligned: np.ndarray   # (P, T) uint8 projection
-    ins_cnt: np.ndarray   # (P, T) int32 insertion counts (uncapped)
-    lead_ins: np.ndarray  # (P,) int32 query bases before column 0
     tlen: int
+    match: np.ndarray | None = None    # (P, T) bool: pass matches consensus
+    aligned: np.ndarray | None = None  # (P, T) uint8 projection
+    ins_cnt: np.ndarray | None = None  # (P, T) int32 insertion counts
+    lead_ins: np.ndarray | None = None  # (P,) int32 bases before column 0
+    bp: int | None = None              # device breakpoint (-1 = none)
+    advance: np.ndarray | None = None  # (P,) int32 bases consumed @ bp_eff
 
     def ins_out(self, speculative: bool = False) -> np.ndarray:
         return msa.emit_insertions(self.ins_base, self.ins_votes,

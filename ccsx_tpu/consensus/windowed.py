@@ -117,7 +117,12 @@ def windowed_gen(passes: List[np.ndarray], cfg: CcsConfig):
                 flag = False
                 break
 
-            bp = find_breakpoint(rr, nseq, cfg)
+            if rr.bp is not None:
+                # device-computed scan (ops/breakpoint.py, batched path):
+                # -1 encodes the spec's None
+                bp = rr.bp if rr.bp >= 1 else None
+            else:
+                bp = find_breakpoint(rr, nseq, cfg)
             if cfg.verbose >= 3:
                 # per-window breakpoint stats, -v level 3 (main.c:619-620)
                 import sys
@@ -139,7 +144,11 @@ def windowed_gen(passes: List[np.ndarray], cfg: CcsConfig):
                 # window_growth="grow")
                 bp = max(rr.tlen - cfg.bp_window, 1)
             out.append(rr.materialize(upto=bp))
-            pos += _advance(rr, bp)[:nseq]  # drop pass-bucket padding rows
+            if rr.advance is not None:
+                # device advance was computed at this same bp_eff
+                pos += rr.advance[:nseq].astype(np.int64)
+            else:
+                pos += _advance(rr, bp)[:nseq]  # drop pass-bucket padding
             break
 
     return np.concatenate(out) if out else np.zeros(0, np.uint8)

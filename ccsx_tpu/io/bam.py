@@ -75,7 +75,13 @@ def read_bam_records(path_or_file, with_aux: bool = False):
     if not hasattr(raw, "peek"):
         raw = io.BufferedReader(raw)
     if raw.peek(2)[:2] == b"\x1f\x8b":
-        if bgzf_path is not None and raw.peek(14)[12:14] != b"BC":
+        head = raw.peek(14)
+        # BGZF = FEXTRA set (byte 3 bit 2) AND a leading BC subfield; a
+        # plain-gzip member whose stored FNAME happens to contain "BC"
+        # at offset 12 must NOT be treated as BGZF
+        if bgzf_path is not None and not (
+                len(head) >= 14 and head[3] & 0x04
+                and head[12:14] == b"BC"):
             bgzf_path = None    # plain gzip, no EOF-marker contract
         f = io.BufferedReader(gzip.GzipFile(fileobj=raw))
     else:
@@ -292,11 +298,17 @@ def write_bam(path, records, refs=(), bgzf: bool = True) -> None:
                            l_seq, -1, -1, 0)
         body += nm + bytes(packed) + q
         for tag, typ, val in aux:
-            body += tag.encode("ascii") + typ.encode("ascii")
+            tb = tag.encode("ascii")
+            if len(tb) != 2:
+                raise BamError(f"aux tag must be 2 ASCII chars: {tag!r}")
+            body += tb + typ.encode("ascii")
             if typ in _AUX_SCALAR:
                 body += struct.pack(_AUX_SCALAR[typ], val)
             elif typ == "A":
-                body += val.encode("ascii")[:1]
+                vb = val.encode("ascii")
+                if len(vb) != 1:
+                    raise BamError(f"aux A value must be 1 char: {val!r}")
+                body += vb
             elif typ in "ZH":
                 body += val.encode() + b"\x00"
             else:

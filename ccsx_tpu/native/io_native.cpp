@@ -105,8 +105,10 @@ struct BgzfMT {
     const char* e = getenv("CCSX_BGZF_THREADS");
     // clamp explicit values too: an absurd count would throw
     // std::system_error from thread creation with no handler across
-    // the ctypes boundary (std::terminate)
-    if (e && *e) return std::min(std::max(1, atoi(e)), 8);
+    // the ctypes boundary (std::terminate).  64 is far above any
+    // useful inflate parallelism but far below failure territory,
+    // so legitimate big-host settings are honored
+    if (e && *e) return std::min(std::max(1, atoi(e)), 64);
     unsigned hc = std::thread::hardware_concurrency();
     return hc > 1 ? (int)std::min(hc, 8u) : 1;
   }

@@ -1,0 +1,78 @@
+"""Device-side breakpoint scan + cursor advance (the reference's MSA
+backward scan, main.c:580-612, and per-pass cursor bump, main.c:622-638).
+
+The host NumPy implementation (consensus/windowed.find_breakpoint and
+_advance) is the SPEC — this module is its jit-compiled equivalent so the
+batched pipeline can keep the whole post-vote analysis on-device and
+return two small arrays (bp scalar + (P,) advance) instead of shipping
+the (Z, P, T) match/aligned/ins_cnt tensors to the host every round
+(SURVEY.md §7.1 L2 lists this reduction as a kernel target).
+Differential-tested bit-equal against the spec in
+tests/test_breakpoint_device.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def make_bp_advance(tmax: int, bp_window: int, bp_minwin: int,
+                    bp_rowrate: int, bp_colrate: int,
+                    bp_colrate_lowpass: int):
+    """Single-hole (vmap over Z) breakpoint + advance.
+
+    Inputs: match (P, tmax) bool, cons (tmax,) uint8, aligned (P, tmax)
+    uint8, ins_cnt (P, tmax) int32, lead_ins (P,) int32, row_mask (P,)
+    bool, tlen scalar int32.
+
+    Returns (bp, advance): bp int32 — the highest valid breakpoint
+    column in [1, tlen - bp_window], or -1 when none exists (the spec's
+    None); advance (P,) int32 — query bases consumed by columns
+    [0, bp_eff) where bp_eff = bp if bp >= 1 else max(tlen - W, 1), the
+    forced-flush column the windowed driver would use.
+    """
+    W = bp_window
+
+    def f(match, cons, aligned, ins_cnt, lead_ins, row_mask, tlen):
+        tlen = jnp.asarray(tlen, jnp.int32)
+        col = jnp.arange(tmax, dtype=jnp.int32)
+        incols = col < tlen
+        nseq = row_mask.sum().astype(jnp.int32)
+        # spec slices [:nseq, :tlen]; here padding rows are already False
+        # in match (the voter masks them) and isbase masks the columns
+        isbase = (cons < 4) & incols
+        matchcnt = match.sum(0).astype(jnp.int32)
+        colrate = jnp.where(nseq >= 10, bp_colrate, bp_colrate_lowpass)
+        colok = matchcnt * 100 >= colrate * nseq
+        badbase = isbase & ~colok
+
+        def wsum(x):
+            c = jnp.cumsum(x.astype(jnp.int32), axis=-1)
+            pad = jnp.zeros(x.shape[:-1] + (1,), jnp.int32)
+            c = jnp.concatenate([pad, c], axis=-1)
+            return c[..., W:] - c[..., :-W]       # (… , tmax - W + 1)
+
+        nog = wsum(isbase)
+        bad = wsum(badbase)
+        rowin = wsum(match & isbase[None, :])
+        idx = jnp.arange(tmax - W + 1, dtype=jnp.int32)
+        valid = (bad == 0) & (nog >= bp_minwin) & isbase[: tmax - W + 1]
+        # every REAL row must match in >= rowrate% of the window's base
+        # columns (spec: .all over match[:nseq]); padding rows pass
+        rows_ok = ((rowin * 100 >= bp_rowrate * nog[None, :])
+                   | ~row_mask[:, None]).all(0)
+        valid &= rows_ok
+        # spec candidates: i in [1, tlen - W] (it scans valid[1:] of the
+        # [:tlen] slice); tlen < W + 1 leaves no candidate -> -1
+        valid &= (idx >= 1) & (idx <= tlen - W)
+        bp = jnp.where(valid, idx, -1).max()
+
+        bp_eff = jnp.where(bp >= 1, bp, jnp.maximum(tlen - W, 1))
+        ccols = col < bp_eff
+        nongap = ((aligned < 4) & ccols[None, :]).sum(1)
+        ins = (ins_cnt * ccols[None, :]).sum(1)
+        advance = (nongap + ins).astype(jnp.int32) + lead_ins
+        return bp.astype(jnp.int32), advance
+
+    return f

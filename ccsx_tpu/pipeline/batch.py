@@ -48,18 +48,24 @@ from ccsx_tpu.utils.metrics import Metrics
 
 
 @functools.lru_cache(maxsize=128)
-def _round_step(params: AlignParams, max_ins: int, tmax: int):
+def _round_step(params: AlignParams, max_ins: int, tmax: int,
+                bp_consts: tuple):
     """Jitted batched star round: (Z, P, qmax) passes vs (Z, tmax) drafts.
 
-    Z/P/qmax shape specialization is left to jit's trace cache; tmax and
-    max_ins fix the projector's output shape so they key the cache here.
+    Z/P/qmax shape specialization is left to jit's trace cache; tmax,
+    max_ins (projector output shape) and the breakpoint constants key
+    the cache here.  The breakpoint scan + cursor advance run on-device
+    (ops/breakpoint.py), so only small per-hole outputs cross to the
+    host — not the (Z, P, tmax) match/aligned/ins_cnt tensors.
     """
     from ccsx_tpu.consensus import star as star_mod
+    from ccsx_tpu.ops import breakpoint as bp_mod
     from ccsx_tpu.ops import msa as msa_mod
 
     aligner = star_mod._aligner(params)  # scan default; env-gated Pallas
     projector = traceback.make_projector(tmax, max_ins)
     voter = msa_mod.make_voter(max_ins)
+    bp_advance = bp_mod.make_bp_advance(tmax, *bp_consts)
 
     @jax.jit
     def step(qs, qlens, ts, tlens, row_mask):
@@ -76,7 +82,9 @@ def _round_step(params: AlignParams, max_ins: int, tmax: int):
         aligned, ins_cnt, ins_b, lead_ins = proj(moves, offs, qs, qlens, tlens)
         cons, ins_base, ins_votes, ncov, match = jax.vmap(voter)(
             aligned, ins_cnt, ins_b, row_mask)
-        return cons, ins_base, ins_votes, ncov, match, aligned, ins_cnt, lead_ins
+        bp, advance = jax.vmap(bp_advance)(
+            match, cons, aligned, ins_cnt, lead_ins, row_mask, tlens)
+        return cons, ins_base, ins_votes, ncov, bp, advance
 
     return step
 
@@ -229,19 +237,22 @@ class BatchExecutor:
                 ts[z] = pad_to(req.draft, tmax)
                 tlens[z] = len(req.draft)
                 row_mask[z] = req.row_mask
-            step = _round_step(cfg.align, cfg.max_ins_per_col, tmax)
+            step = _round_step(cfg.align, cfg.max_ins_per_col, tmax,
+                               (cfg.bp_window, cfg.bp_minwin,
+                                cfg.bp_rowrate, cfg.bp_colrate,
+                                cfg.bp_colrate_lowpass))
             args = (qs, qlens, ts, tlens, row_mask)
             if self._sharding is not None:
                 args = tuple(jax.device_put(a, self._sharding) for a in args)
             out = step(*args)
-            (cons, ins_base, ins_votes, ncov, match,
-             aligned, ins_cnt, lead_ins) = (np.asarray(o) for o in out)
+            (cons, ins_base, ins_votes, ncov, bp, advance) = (
+                np.asarray(o) for o in out)
             for z, i in enumerate(idxs):
                 results[i] = RoundResult(
                     cons=cons[z], ins_base=ins_base[z],
-                    ins_votes=ins_votes[z], ncov=ncov[z], match=match[z],
-                    aligned=aligned[z], ins_cnt=ins_cnt[z],
-                    lead_ins=lead_ins[z], tlen=len(requests[i].draft),
+                    ins_votes=ins_votes[z], ncov=ncov[z],
+                    tlen=len(requests[i].draft),
+                    bp=int(bp[z]), advance=advance[z],
                 )
         return results
 

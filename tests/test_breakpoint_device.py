@@ -1,0 +1,81 @@
+"""Differential tests: device breakpoint/advance vs the NumPy spec.
+
+ops/breakpoint.py must reproduce consensus/windowed.find_breakpoint and
+_advance exactly (the spec of the reference scan, main.c:580-612 and
+622-638) — including the None (-1) cases, the <10-pass colrate switch,
+and tiny MSAs below the scan window.
+"""
+
+import jax
+import numpy as np
+
+from ccsx_tpu.config import CcsConfig
+from ccsx_tpu.consensus import windowed as win_mod
+from ccsx_tpu.consensus.star import StarMsa
+from ccsx_tpu.ops import breakpoint as bp_mod
+from ccsx_tpu.utils import synth
+
+
+def _cases(rng):
+    """(passes, tlen) cases spanning the scan's regimes."""
+    out = []
+    # agreeing 6-pass window (normal breakpoint)
+    tpl = rng.integers(0, 4, 400).astype(np.uint8)
+    out.append([synth.mutate(rng, tpl, 0.02, 0.04, 0.04) for _ in range(6)]
+               + [tpl])
+    # 12 passes: the >=10-pass colrate (80) applies
+    out.append([synth.mutate(rng, tpl, 0.02, 0.04, 0.04) for _ in range(12)]
+               + [tpl])
+    # 3 passes at brutal error: likely no breakpoint (None/-1)
+    out.append([synth.mutate(rng, tpl, 0.12, 0.15, 0.15) for _ in range(3)]
+               + [tpl])
+    # tiny template below the scan window
+    tiny = rng.integers(0, 4, 8).astype(np.uint8)
+    out.append([synth.mutate(rng, tiny, 0.05, 0.0, 0.0) for _ in range(4)]
+               + [tiny])
+    return out
+
+
+def test_device_matches_spec(rng):
+    cfg = CcsConfig(is_bam=False)
+    sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
+    for case in _cases(rng):
+        passes, draft = case[:-1], case[-1]
+        qs, qlens, row_mask = sm.pack(passes, cfg.pass_buckets,
+                                      cfg.max_passes)
+        ra = sm.round(qs, qlens, row_mask, draft)
+        nseq = len(passes)
+        host_bp = win_mod.find_breakpoint(ra, nseq, cfg)
+        bp_eff = host_bp if host_bp is not None else max(
+            ra.tlen - cfg.bp_window, 1)
+        host_adv = win_mod._advance(ra, bp_eff)
+
+        tmax = ra.cons.shape[0]
+        f = jax.jit(bp_mod.make_bp_advance(
+            tmax, cfg.bp_window, cfg.bp_minwin, cfg.bp_rowrate,
+            cfg.bp_colrate, cfg.bp_colrate_lowpass))
+        bp_d, adv_d = f(ra.match, ra.cons, ra.aligned, ra.ins_cnt,
+                        ra.lead_ins.astype(np.int32), row_mask,
+                        np.int32(ra.tlen))
+        bp_d = int(bp_d)
+        assert (bp_d if bp_d >= 1 else None) == host_bp, \
+            f"device bp {bp_d} != spec {host_bp} (nseq={nseq})"
+        np.testing.assert_array_equal(
+            np.asarray(adv_d), host_adv.astype(np.int32))
+
+
+def test_device_none_encoding_small_tlen(rng):
+    """tlen < bp_window + 1 must yield -1 (spec returns None early)."""
+    cfg = CcsConfig(is_bam=False)
+    f = jax.jit(bp_mod.make_bp_advance(
+        64, cfg.bp_window, cfg.bp_minwin, cfg.bp_rowrate,
+        cfg.bp_colrate, cfg.bp_colrate_lowpass))
+    P, T = 4, 64
+    match = np.ones((P, T), bool)
+    cons = np.zeros(T, np.uint8)
+    aligned = np.zeros((P, T), np.uint8)
+    ins_cnt = np.zeros((P, T), np.int32)
+    lead = np.zeros(P, np.int32)
+    mask = np.ones(P, bool)
+    bp, _ = f(match, cons, aligned, ins_cnt, lead, mask, np.int32(6))
+    assert int(bp) == -1
