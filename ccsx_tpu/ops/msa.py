@@ -71,8 +71,10 @@ def emit_insertions(ins_base: np.ndarray, ins_votes: np.ndarray,
     does not split — wrong speculations are then deleted by majority gap.
     """
     ins_base = np.asarray(ins_base)
-    ins_votes = np.asarray(ins_votes)
-    n = np.asarray(ncov)[:, None]
+    # widen before arithmetic: the batched round transfers votes/coverage
+    # as uint8 (bounded by the pass bucket) and *2 / //3 must not wrap
+    ins_votes = np.asarray(ins_votes).astype(np.int32, copy=False)
+    n = np.asarray(ncov).astype(np.int32, copy=False)[:, None]
     emit = ins_votes * 2 > n
     if speculative:
         emit |= ins_votes >= np.maximum(2, -(-n // 3))

@@ -84,7 +84,12 @@ def _round_step(params: AlignParams, max_ins: int, tmax: int,
             aligned, ins_cnt, ins_b, row_mask)
         bp, advance = jax.vmap(bp_advance)(
             match, cons, aligned, ins_cnt, lead_ins, row_mask, tlens)
-        return cons, ins_base, ins_votes, ncov, bp, advance
+        # compact the d2h payload: votes/coverage are bounded by the pass
+        # count (<= 64 with the largest pass bucket), so uint8 halves the
+        # transfer; the host casts back before arithmetic
+        # (msa.emit_insertions)
+        return (cons, ins_base, ins_votes.astype(jax.numpy.uint8),
+                ncov.astype(jax.numpy.uint8), bp, advance)
 
     return step
 
