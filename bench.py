@@ -152,12 +152,21 @@ def main():
     # CCSX_BENCH_E2E=0 skips; CCSX_BENCH_E2E_HOLES resizes (default 8).
     if os.environ.get("CCSX_BENCH_E2E", "1") != "0":
         holes = int(os.environ.get("CCSX_BENCH_E2E_HOLES", "8"))
+        # soft deadline: cold compiles through a remote-compile tunnel
+        # can take minutes per config; losing the whole JSON line to a
+        # driver timeout is worse than skipping tail configs
+        deadline = time.monotonic() + float(
+            os.environ.get("CCSX_BENCH_DEADLINE", "420"))
         sys.path.insert(0, os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
         import e2e as e2e_mod
 
         results = []
         for cfg in (1, 2, 3, 4, 5):
+            if time.monotonic() > deadline:
+                results.append({"config": cfg,
+                                "skipped": "bench deadline exceeded"})
+                continue
             try:
                 r = e2e_mod.run_config(cfg, holes, "auto")
                 results.append({k: r[k] for k in (
