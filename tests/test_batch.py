@@ -143,3 +143,25 @@ def test_cli_batched_journal_resume(tmp_path, rng):
                      "--journal", str(jp), str(fa), str(out)]) == 0
     assert out.read_text() == full.read_text()
     assert json.loads(jp.read_text())["holes_done"] == 3
+
+
+def test_executor_deep_pass_vote_compaction(rng):
+    """uint8 vote/coverage transfer must stay exact at the deepest pass
+    bucket (64): votes*2 reaches 128 — the compaction headroom case."""
+    cfg = CcsConfig(is_bam=False, max_passes=64,
+                    pass_buckets=(4, 8, 16, 32, 64))
+    sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
+    tpl = rng.integers(0, 4, 300).astype(np.uint8)
+    from ccsx_tpu.utils import synth as synth_mod
+
+    ps = [synth_mod.mutate(rng, tpl, 0.02, 0.04, 0.04) for _ in range(40)]
+    qs, qlens, row_mask = sm.pack(ps, cfg.pass_buckets, cfg.max_passes)
+    req = RoundRequest(qs, qlens, row_mask, ps[0])
+    rb = BatchExecutor(cfg).run([req])[0]
+    ra = sm.round(req.qs, req.qlens, req.row_mask, req.draft)
+    np.testing.assert_array_equal(ra.cons, rb.cons)
+    np.testing.assert_array_equal(ra.ins_votes, rb.ins_votes)
+    np.testing.assert_array_equal(ra.ncov, rb.ncov)
+    assert int(np.asarray(rb.ncov).max()) == 40
+    # materialization arithmetic (votes*2 > ncov) must agree too
+    np.testing.assert_array_equal(ra.materialize(), rb.materialize())
