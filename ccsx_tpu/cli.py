@@ -82,6 +82,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Debug verbosity (repeatable)")
     # TPU-era extensions
     p.add_argument("--device", default="auto", choices=["auto", "tpu", "cpu"])
+    p.add_argument("--mesh", default=None, metavar="D,P",
+                   help="Batched-pipeline device mesh as data,pass (e.g. "
+                        "4,2); default: all devices on the data axis")
     p.add_argument("--refine-iters", type=int, default=2)
     p.add_argument("--max-passes", type=int, default=32)
     p.add_argument("--window-growth", default="flush",
@@ -125,6 +128,16 @@ def config_from_args(args) -> CcsConfig:
     exclude = None
     if args.exclude:
         exclude = frozenset(x for x in args.exclude.split(",") if x)
+    mesh_shape = None
+    if getattr(args, "mesh", None):
+        try:
+            mesh_shape = tuple(int(x) for x in args.mesh.split(","))
+            if len(mesh_shape) != 2 or min(mesh_shape) < 1:
+                raise ValueError
+        except ValueError:
+            print(f"Error: --mesh expects D,P integers, got {args.mesh!r}",
+                  file=sys.stderr)
+            raise SystemExit(1)
     return CcsConfig(
         min_subread_len=args.min_len,
         max_subread_len=args.max_len,
@@ -137,6 +150,7 @@ def config_from_args(args) -> CcsConfig:
         refine_iters=args.refine_iters,
         max_passes=args.max_passes,
         window_growth=args.window_growth,
+        mesh_shape=mesh_shape,
         device=args.device,
         metrics_path=args.metrics,
     )
@@ -186,6 +200,9 @@ def main(argv: Optional[list] = None) -> int:
     batch = args.batch
     if batch == "auto":
         batch = "on" if backend == "tpu" else "off"
+    if cfg.mesh_shape is not None and batch == "off":
+        print("[ccsx-tpu] --mesh has no effect with --batch off",
+              file=sys.stderr)
 
     def _run():
         if sharded:

@@ -87,7 +87,9 @@ class CcsConfig:
 
     # ---- device/mesh ----
     device: str = "auto"               # {auto, tpu, cpu}
-    mesh_shape: Optional[tuple] = None  # e.g. (8,) data; None = all local devices
+    mesh_shape: Optional[tuple] = None  # (data, pass) for the batched
+    #   pipeline's device mesh, e.g. (4, 2); (D,) means (D, 1); None =
+    #   all local devices on the data axis (CLI: --mesh D,P)
 
     # ---- observability (SURVEY.md §5.1/5.5: absent in the reference) ----
     metrics_path: Optional[str] = None  # JSON-lines metrics events

@@ -14,9 +14,12 @@ The sharded step below is exercised by __graft_entry__.dryrun_multichip
 and tests/test_sharded_round.py, both of which assert its four outputs
 equal the unsharded per-hole star round BIT-EXACTLY (the vote is a pure
 pass-axis reduction, so sharding must change nothing).  The production
-batched runner (pipeline/batch.py) shards its rounds over the data axis
-only — ZMWs are independent, so pass-axis collectives only pay off for
-deep-pass holes on real multi-chip slices.
+batched runner (pipeline/batch.py) lays its rounds over the same
+(data, pass) mesh via input NamedShardings (--mesh D,P; default pure
+data) — GSPMD inserts the identical psums; its mesh path is pinned
+bit-equal to the per-hole rounds in tests/test_batch.py.  This module's
+explicit shard_map version remains the reference formulation and the
+dryrun target.
 """
 
 from __future__ import annotations

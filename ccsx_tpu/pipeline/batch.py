@@ -200,14 +200,41 @@ class BatchExecutor:
         self.cfg = cfg
         self.len_quant = cfg.len_bucket_quant
         self.metrics = metrics
-        self._sharding = None
+        self._mesh = None
         n_dev = len(jax.devices())
         if n_dev > 1:
-            from jax.sharding import Mesh, NamedSharding, PartitionSpec
+            # (data, pass) mesh: ZMWs shard over 'data'; MSA rows of each
+            # hole shard over 'pass' when the pass bucket divides (GSPMD
+            # partitions the jitted round from the input shardings alone —
+            # the vote's column reductions become psums over 'pass', the
+            # same collectives tests/test_sharded_round.py pins bit-exact).
+            # cfg.mesh_shape overrides the default pure-data split; a
+            # 1-tuple means pure data parallelism; extra devices idle.
+            shape = self.normalize_mesh_shape(cfg.mesh_shape, n_dev)
+            ndev_used = int(np.prod(shape))
+            if ndev_used > n_dev:
+                raise ValueError(
+                    f"mesh_shape {shape} needs {ndev_used} devices, "
+                    f"host has {n_dev}")
+            from ccsx_tpu.parallel.mesh import build_mesh
 
-            mesh = Mesh(np.array(jax.devices()), axis_names=("data",))
-            self._sharding = NamedSharding(mesh, PartitionSpec("data"))
-            self._ndev = n_dev
+            self._mesh = build_mesh(shape=shape,
+                                    devices=jax.devices()[:ndev_used])
+            self._data_dim, self._pass_dim = shape
+        elif cfg.mesh_shape is not None:
+            print("[ccsx-tpu] --mesh ignored: single device visible",
+                  file=sys.stderr)
+
+    @staticmethod
+    def normalize_mesh_shape(shape, n_dev: int):
+        if shape is None:
+            return (n_dev, 1)
+        if len(shape) == 1:
+            return (shape[0], 1)
+        if len(shape) != 2:
+            raise ValueError(f"mesh_shape must be (data,) or (data, pass), "
+                             f"got {shape}")
+        return tuple(shape)
 
     def run(self, requests: List[RoundRequest]) -> List[RoundResult]:
         """Satisfy all requests; results align index-for-index."""
@@ -225,11 +252,11 @@ class BatchExecutor:
         for (P, qmax, tmax), idxs in groups.items():
             n = len(idxs)
             Z = _z_bucket(n)
-            if self._sharding is not None:
-                # the data-axis NamedSharding needs Z divisible by the
-                # device count (power-of-two Z alone is not enough when
-                # ndev isn't a power of two, e.g. 6 or 12 devices)
-                Z = -(-Z // self._ndev) * self._ndev
+            if self._mesh is not None:
+                # the data-axis sharding needs Z divisible by the data
+                # dimension (power-of-two Z alone is not enough when it
+                # isn't a power of two, e.g. 6 or 12 devices)
+                Z = -(-Z // self._data_dim) * self._data_dim
             qs = np.zeros((Z, P, qmax), np.uint8)
             qlens = np.zeros((Z, P), np.int32)
             ts = np.zeros((Z, tmax), np.uint8)
@@ -247,8 +274,17 @@ class BatchExecutor:
                                 cfg.bp_rowrate, cfg.bp_colrate,
                                 cfg.bp_colrate_lowpass))
             args = (qs, qlens, ts, tlens, row_mask)
-            if self._sharding is not None:
-                args = tuple(jax.device_put(a, self._sharding) for a in args)
+            if self._mesh is not None:
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as PS
+
+                # replicate the pass axis when the bucket doesn't divide
+                pax = "pass" if P % self._pass_dim == 0 else None
+                specs = (PS("data", pax, None), PS("data", pax),
+                         PS("data", None), PS("data"), PS("data", pax))
+                args = tuple(
+                    jax.device_put(a, NamedSharding(self._mesh, s))
+                    for a, s in zip(args, specs))
             out = step(*args)
             (cons, ins_base, ins_votes, ncov, bp, advance) = (
                 np.asarray(o) for o in out)
@@ -439,14 +475,31 @@ def run_pipeline_batched(in_path: str, out_path: str, cfg: CcsConfig,
     except (OSError, RuntimeError) as e:
         print(f"Error: Failed to open infile! ({e})", file=sys.stderr)
         return 1
+
+    # resolve the backend and validate the mesh BEFORE the writer opens:
+    # a bad --mesh must not truncate an existing output file
+    resolve_device(cfg.device)
+    if cfg.mesh_shape is not None:
+        import jax
+
+        n_dev = len(jax.devices())
+        try:
+            shape = BatchExecutor.normalize_mesh_shape(cfg.mesh_shape,
+                                                       n_dev)
+            if n_dev > 1 and int(np.prod(shape)) > n_dev:
+                raise ValueError(f"mesh {shape} needs "
+                                 f"{int(np.prod(shape))} devices, host "
+                                 f"has {n_dev}")
+        except ValueError as e:
+            print(f"Error: invalid --mesh: {e}", file=sys.stderr)
+            return 1
+
     journal = Journal.load_or_create(journal_path, input_id=in_path)
     try:
         writer = open_writer(out_path, append=bool(journal.holes_done))
     except OSError:
         print("Cannot open file for write!", file=sys.stderr)
         return 1
-
-    resolve_device(cfg.device)
     metrics = Metrics(verbose=cfg.verbose, stream=cfg.metrics_stream())
     return drive_batched(stream, writer, cfg, journal, metrics,
                          inflight or cfg.zmw_microbatch)

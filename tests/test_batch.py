@@ -165,3 +165,65 @@ def test_executor_deep_pass_vote_compaction(rng):
     assert int(np.asarray(rb.ncov).max()) == 40
     # materialization arithmetic (votes*2 > ncov) must agree too
     np.testing.assert_array_equal(ra.materialize(), rb.materialize())
+
+
+@pytest.mark.parametrize("mesh", [(4, 2), (2, 4), (8, 1)])
+def test_executor_pass_axis_mesh_matches_per_hole(rng, mesh):
+    """The production batched round under a (data, pass) mesh must equal
+    the per-hole rounds exactly — GSPMD's psums over 'pass' are the same
+    collectives tests/test_sharded_round.py pins."""
+    cfg = CcsConfig(is_bam=False, mesh_shape=mesh)
+    sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
+    reqs = []
+    for i in range(5):
+        ps = _passes(rng, n=5 + (i % 4), tlen=500 + 40 * i)  # P bucket 8
+        qs, qlens, row_mask = sm.pack(ps, cfg.pass_buckets, cfg.max_passes)
+        reqs.append(RoundRequest(qs, qlens, row_mask, ps[0]))
+    batched = BatchExecutor(cfg).run(reqs)
+    from ccsx_tpu.consensus import windowed as win_mod
+
+    for req, rb in zip(reqs, batched):
+        ra = sm.round(req.qs, req.qlens, req.row_mask, req.draft)
+        np.testing.assert_array_equal(ra.cons, rb.cons)
+        np.testing.assert_array_equal(ra.ins_base, rb.ins_base)
+        np.testing.assert_array_equal(ra.ins_votes, rb.ins_votes)
+        np.testing.assert_array_equal(ra.ncov, rb.ncov)
+        # the on-device breakpoint/advance must survive the pass axis too
+        nseq = int(req.row_mask.sum())
+        host_bp = win_mod.find_breakpoint(ra, nseq, cfg)
+        assert (rb.bp if rb.bp >= 1 else None) == host_bp
+        bp_eff = host_bp if host_bp is not None else max(
+            ra.tlen - cfg.bp_window, 1)
+        np.testing.assert_array_equal(
+            rb.advance, win_mod._advance(ra, bp_eff).astype(np.int32))
+
+
+def test_cli_mesh_flag_output_identical(tmp_path, rng):
+    """--mesh 4,2 (pass-parallel production path) == --batch off output."""
+    zs, fa = _make_inputs(tmp_path, rng, n_holes=3)
+    o_ref = tmp_path / "ref.fa"
+    o_mesh = tmp_path / "mesh.fa"
+    assert cli.main(["-A", "-m", "1000", "--batch", "off",
+                     str(fa), str(o_ref)]) == 0
+    assert cli.main(["-A", "-m", "1000", "--batch", "on", "--mesh", "4,2",
+                     str(fa), str(o_mesh)]) == 0
+    assert o_ref.read_text() == o_mesh.read_text()
+
+
+def test_cli_mesh_flag_invalid(tmp_path, capsys):
+    rc = cli.main(["--mesh", "nope", "x.fa", str(tmp_path / "y.fa")])
+    assert rc == 1
+    assert "--mesh" in capsys.readouterr().err
+
+
+def test_cli_mesh_too_large_clean_error(tmp_path, rng, capsys):
+    """An infeasible --mesh fails rc 1 with a clean message and must NOT
+    truncate an existing output file."""
+    zs, fa = _make_inputs(tmp_path, rng, n_holes=1)
+    out = tmp_path / "o.fa"
+    out.write_text("precious\n")
+    rc = cli.main(["-A", "-m", "1000", "--batch", "on", "--mesh", "16,2",
+                   str(fa), str(out)])
+    assert rc == 1
+    assert "invalid --mesh" in capsys.readouterr().err
+    assert out.read_text() == "precious\n"
