@@ -112,6 +112,19 @@ def run_pipeline_sharded(in_path: str, out_path: str, cfg: CcsConfig,
     except (OSError, RuntimeError) as e:
         print(f"Error: Failed to open infile! ({e})", file=sys.stderr)
         return 1
+    # validate the mesh BEFORE the shard writer truncates its file
+    # (same single validation point as the single-host driver)
+    resolve_device(cfg.device)
+    if cfg.mesh_shape is not None:
+        import jax
+
+        from ccsx_tpu.pipeline.batch import BatchExecutor
+
+        try:
+            BatchExecutor.validate_mesh(cfg.mesh_shape, len(jax.devices()))
+        except ValueError as e:
+            print(f"Error: invalid --mesh: {e}", file=sys.stderr)
+            return 1
     jp = f"{journal_path}.shard{rank}" if journal_path else None
     journal = Journal.load_or_create(jp, input_id=f"{in_path}#{rank}/{n}")
     try:
@@ -121,7 +134,6 @@ def run_pipeline_sharded(in_path: str, out_path: str, cfg: CcsConfig,
         print("Cannot open file for write!", file=sys.stderr)
         return 1
 
-    resolve_device(cfg.device)
     metrics = Metrics(verbose=cfg.verbose, stream=cfg.metrics_stream())
     return drive_batched(shard_stream(stream, rank, n), writer, cfg,
                          journal, metrics, inflight or cfg.zmw_microbatch)

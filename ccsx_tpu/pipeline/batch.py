@@ -210,17 +210,19 @@ class BatchExecutor:
             # same collectives tests/test_sharded_round.py pins bit-exact).
             # cfg.mesh_shape overrides the default pure-data split; a
             # 1-tuple means pure data parallelism; extra devices idle.
-            shape = self.normalize_mesh_shape(cfg.mesh_shape, n_dev)
+            shape = self.validate_mesh(cfg.mesh_shape, n_dev)
             ndev_used = int(np.prod(shape))
-            if ndev_used > n_dev:
-                raise ValueError(
-                    f"mesh_shape {shape} needs {ndev_used} devices, "
-                    f"host has {n_dev}")
             from ccsx_tpu.parallel.mesh import build_mesh
 
             self._mesh = build_mesh(shape=shape,
                                     devices=jax.devices()[:ndev_used])
             self._data_dim, self._pass_dim = shape
+            if (self._pass_dim > 1
+                    and all(b % self._pass_dim for b in cfg.pass_buckets)):
+                print(f"[ccsx-tpu] mesh pass dim {self._pass_dim} divides "
+                      f"no pass bucket {tuple(cfg.pass_buckets)}: pass "
+                      "axis will be replicated (no pass parallelism)",
+                      file=sys.stderr)
         elif cfg.mesh_shape is not None:
             print("[ccsx-tpu] --mesh ignored: single device visible",
                   file=sys.stderr)
@@ -229,12 +231,27 @@ class BatchExecutor:
     def normalize_mesh_shape(shape, n_dev: int):
         if shape is None:
             return (n_dev, 1)
+        shape = tuple(int(x) for x in shape)
         if len(shape) == 1:
-            return (shape[0], 1)
+            shape = (shape[0], 1)
         if len(shape) != 2:
             raise ValueError(f"mesh_shape must be (data,) or (data, pass), "
                              f"got {shape}")
-        return tuple(shape)
+        if min(shape) < 1:
+            raise ValueError(f"mesh dims must be >= 1: {shape}")
+        return shape
+
+    @classmethod
+    def validate_mesh(cls, mesh_shape, n_dev: int):
+        """Normalize + feasibility-check a mesh shape; ValueError on a
+        bad one.  THE single validation point — __init__ and both
+        pipeline drivers call this (before any output file opens)."""
+        shape = cls.normalize_mesh_shape(mesh_shape, n_dev)
+        need = int(np.prod(shape))
+        if n_dev > 1 and need > n_dev:
+            raise ValueError(
+                f"mesh {shape} needs {need} devices, host has {n_dev}")
+        return shape
 
     def run(self, requests: List[RoundRequest]) -> List[RoundResult]:
         """Satisfy all requests; results align index-for-index."""
@@ -482,14 +499,8 @@ def run_pipeline_batched(in_path: str, out_path: str, cfg: CcsConfig,
     if cfg.mesh_shape is not None:
         import jax
 
-        n_dev = len(jax.devices())
         try:
-            shape = BatchExecutor.normalize_mesh_shape(cfg.mesh_shape,
-                                                       n_dev)
-            if n_dev > 1 and int(np.prod(shape)) > n_dev:
-                raise ValueError(f"mesh {shape} needs "
-                                 f"{int(np.prod(shape))} devices, host "
-                                 f"has {n_dev}")
+            BatchExecutor.validate_mesh(cfg.mesh_shape, len(jax.devices()))
         except ValueError as e:
             print(f"Error: invalid --mesh: {e}", file=sys.stderr)
             return 1
