@@ -200,7 +200,8 @@ def main(argv: Optional[list] = None) -> int:
     batch = args.batch
     if batch == "auto":
         batch = "on" if backend == "tpu" else "off"
-    if cfg.mesh_shape is not None and batch == "off":
+    if cfg.mesh_shape is not None and batch == "off" and not sharded:
+        # (sharded runs always use the batched executor, mesh included)
         print("[ccsx-tpu] --mesh has no effect with --batch off",
               file=sys.stderr)
 

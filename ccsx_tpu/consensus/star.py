@@ -141,6 +141,35 @@ def run_rounds(gen, sm: "StarMsa"):
         return e.value
 
 
+def refine_rounds_gen(qs, qlens, row_mask, draft, iters: int,
+                      strict: bool = True):
+    """Shared refinement loop: iters speculative rounds + a strict one,
+    with a fixpoint early-exit.  Yields RoundRequests; returns
+    (draft, last RoundResult).
+
+    When a speculative round leaves the draft unchanged, a re-round on
+    it would return the same RoundResult (the round is a pure function
+    of its request), so the remaining speculative rounds are no-ops and
+    the final strict output is this round's strict materialization —
+    the dispatches are skipped, bit-identically (tested in
+    test_consensus.py).  ``strict=False`` callers (non-final windows,
+    which consume only the RoundResult) skip the strict materialize at
+    the fixpoint."""
+    rr = None
+    it = 0
+    while it <= iters:
+        rr = yield RoundRequest(qs, qlens, row_mask, draft)
+        spec = it < iters
+        new_draft = rr.materialize(speculative=spec)
+        if spec and np.array_equal(new_draft, draft):
+            if strict:
+                draft = rr.materialize(speculative=False)
+            return draft, rr
+        draft = new_draft
+        it += 1
+    return draft, rr
+
+
 @dataclasses.dataclass
 class RoundResult:
     """Device arrays from one star-MSA round (draft coordinates).
@@ -226,10 +255,8 @@ class StarMsa:
         """Generator form of consensus(): yields RoundRequests, receives
         RoundResults, returns the final draft via StopIteration.value."""
         qs, qlens, row_mask = self.pack(passes, pass_buckets, max_passes)
-        draft = passes[0]
-        for it in range(iters + 1):
-            rr = yield RoundRequest(qs, qlens, row_mask, draft)
-            draft = rr.materialize(speculative=(it < iters))
+        draft, _rr = yield from refine_rounds_gen(
+            qs, qlens, row_mask, passes[0], iters)
         return draft
 
     def consensus(self, passes: List[np.ndarray], iters: int,

@@ -30,7 +30,7 @@ import numpy as np
 from ccsx_tpu.config import CcsConfig
 from ccsx_tpu.consensus import prepare as prep
 from ccsx_tpu.consensus.star import (
-    RoundRequest, RoundResult, StarMsa, run_rounds,
+    RoundResult, StarMsa, refine_rounds_gen, run_rounds,
 )
 from ccsx_tpu.ops import encode as enc
 
@@ -106,11 +106,11 @@ def windowed_gen(passes: List[np.ndarray], cfg: CcsConfig):
                            for k, p in enumerate(passes)]
             qs, qlens, row_mask = sm.pack(
                 windows, cfg.pass_buckets, cfg.max_passes)
-            draft = windows[0]
-            rr = None
-            for it in range(cfg.refine_iters + 1):
-                rr = yield RoundRequest(qs, qlens, row_mask, draft)
-                draft = rr.materialize(speculative=(it < cfg.refine_iters))
+            # strict draft only needed on the final flush; non-final
+            # windows consume only rr (materialize(upto=bp) + advance)
+            draft, rr = yield from refine_rounds_gen(
+                qs, qlens, row_mask, windows[0], cfg.refine_iters,
+                strict=final)
 
             if final:
                 out.append(draft)

@@ -115,16 +115,10 @@ def run_pipeline_sharded(in_path: str, out_path: str, cfg: CcsConfig,
     # validate the mesh BEFORE the shard writer truncates its file
     # (same single validation point as the single-host driver)
     resolve_device(cfg.device)
-    if cfg.mesh_shape is not None:
-        import jax
+    from ccsx_tpu.pipeline.batch import mesh_precheck
 
-        from ccsx_tpu.pipeline.batch import BatchExecutor
-
-        try:
-            BatchExecutor.validate_mesh(cfg.mesh_shape, len(jax.devices()))
-        except ValueError as e:
-            print(f"Error: invalid --mesh: {e}", file=sys.stderr)
-            return 1
+    if mesh_precheck(cfg):
+        return 1
     jp = f"{journal_path}.shard{rank}" if journal_path else None
     journal = Journal.load_or_create(jp, input_id=f"{in_path}#{rank}/{n}")
     try:

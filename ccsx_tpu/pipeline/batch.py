@@ -480,6 +480,22 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
     return rc
 
 
+def mesh_precheck(cfg: CcsConfig) -> int:
+    """0 when cfg.mesh_shape is feasible (or unset); 1 with a stderr
+    message otherwise.  Shared by both pipeline drivers — call after
+    resolve_device and BEFORE opening any output file."""
+    if cfg.mesh_shape is None:
+        return 0
+    import jax
+
+    try:
+        BatchExecutor.validate_mesh(cfg.mesh_shape, len(jax.devices()))
+    except ValueError as e:
+        print(f"Error: invalid --mesh: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def run_pipeline_batched(in_path: str, out_path: str, cfg: CcsConfig,
                          journal_path: Optional[str] = None,
                          inflight: Optional[int] = None) -> int:
@@ -496,14 +512,8 @@ def run_pipeline_batched(in_path: str, out_path: str, cfg: CcsConfig,
     # resolve the backend and validate the mesh BEFORE the writer opens:
     # a bad --mesh must not truncate an existing output file
     resolve_device(cfg.device)
-    if cfg.mesh_shape is not None:
-        import jax
-
-        try:
-            BatchExecutor.validate_mesh(cfg.mesh_shape, len(jax.devices()))
-        except ValueError as e:
-            print(f"Error: invalid --mesh: {e}", file=sys.stderr)
-            return 1
+    if mesh_precheck(cfg):
+        return 1
 
     journal = Journal.load_or_create(journal_path, input_id=in_path)
     try:

@@ -159,3 +159,33 @@ def test_quality_scales_with_passes(rng):
     assert i6 > 0.99, i6
     assert i10 > 0.995, i10
     assert i10 >= i6 - 1e-6
+
+
+def test_refine_fixpoint_early_exit_identical(rng):
+    """The fixpoint early-exit must be invisible in the output and must
+    actually save dispatches on a converged hole."""
+    from ccsx_tpu.config import CcsConfig
+    from ccsx_tpu.consensus.hole import _counted
+    from ccsx_tpu.consensus.star import StarMsa, run_rounds
+    from ccsx_tpu.utils import synth
+
+    cfg = CcsConfig(is_bam=False)
+    sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
+    tpl = rng.integers(0, 4, 400).astype(np.uint8)
+    ps = [synth.mutate(rng, tpl, 0.005, 0.01, 0.01) for _ in range(8)]
+
+    # reference driver WITHOUT the skip: always iters+1 rounds
+    qs, qlens, row_mask = sm.pack(ps, cfg.pass_buckets, cfg.max_passes)
+    draft = ps[0]
+    for it in range(cfg.refine_iters + 1):
+        rr = sm.round(qs, qlens, row_mask, draft)
+        draft = rr.materialize(speculative=(it < cfg.refine_iters))
+    want = draft
+
+    stats = {}
+    gen = _counted(sm.consensus_gen(ps, cfg.refine_iters, cfg.pass_buckets,
+                                    cfg.max_passes), stats)
+    got = run_rounds(gen, sm)
+    np.testing.assert_array_equal(want, got)
+    # 8 nearly-clean passes converge after one speculative round
+    assert stats["windows"] < cfg.refine_iters + 1, stats
