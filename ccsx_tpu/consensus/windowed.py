@@ -15,8 +15,10 @@ keep exactly that structure — it is what makes the kernel shapes static:
     emit consensus columns before the breakpoint; advance each cursor by
       the bases that pass consumed there (main.c:622-638)
     no breakpoint -> grow the window by window_add (main.c:550) up to
-      max_window (we force a flush there instead of growing unboundedly —
-      a documented delta: the reference can grow without limit)
+      max_window, then force a flush (delta vs the reference's unbounded
+      growth; --window-growth grow restores reference behavior — measured
+      equivalent either way, BASELINE.md: the draft-anchored star MSA
+      always finds breakpoints, so growth never engages in practice)
     any pass nearly exhausted (pos + window + minlen >= len) or <3 passes
       -> final flush of all tails (main.c:555-564)
 """
