@@ -74,3 +74,33 @@ def test_metrics_jsonl(tmp_path, rng):
     events = [json.loads(line) for line in m.read_text().splitlines()]
     assert events and events[-1]["event"] == "final"
     assert events[-1]["holes_out"] == out.read_text().count(">")
+
+
+def test_sharded_run_with_mesh_matches_single_host(tmp_path, rng):
+    """--hosts with --mesh 4,2: sharded + pass-parallel rounds must still
+    merge to the exact single-host output."""
+    zs, fa = _make_inputs(tmp_path, rng, n_holes=5)
+    ref = tmp_path / "ref.fa"
+    assert cli.main(["-A", "-m", "1000", "--batch", "on",
+                     str(fa), str(ref)]) == 0
+    out = tmp_path / "dist.fa"
+    for r in range(2):
+        assert cli.main(["-A", "-m", "1000", "--hosts", "2",
+                         "--host-id", str(r), "--mesh", "4,2",
+                         str(fa), str(out)]) == 0
+    assert cli.main(["--merge-shards", "2", "ignored.in", str(out)]) == 0
+    assert out.read_text() == ref.read_text()
+
+
+def test_sharded_run_invalid_mesh_clean_error(tmp_path, rng, capsys):
+    """An infeasible --mesh in a sharded run fails rc 1 without
+    truncating an existing shard file."""
+    zs, fa = _make_inputs(tmp_path, rng, n_holes=2)
+    out = tmp_path / "o.fa"
+    shard = tmp_path / "o.fa.shard0"
+    shard.write_text("precious\n")
+    rc = cli.main(["-A", "-m", "1000", "--hosts", "2", "--host-id", "0",
+                   "--mesh", "16,2", str(fa), str(out)])
+    assert rc == 1
+    assert "invalid --mesh" in capsys.readouterr().err
+    assert shard.read_text() == "precious\n"
