@@ -52,7 +52,7 @@ def full_gen_for_zmw(zmw, cfg: CcsConfig):
     """Combined prep + consensus generator for one hole.
 
     Yields prepare.PairRequest during the orientation walk, then
-    star.RoundRequest during consensus (the driver dispatches on type,
+    star.RefineRequest during consensus (the driver dispatches on type,
     batching each across holes); returns the consensus codes (or None
     for a skipped hole) via StopIteration.value.
     """
@@ -67,7 +67,8 @@ def full_gen_for_zmw(zmw, cfg: CcsConfig):
 
 
 def _counted(gen, stats: dict):
-    """Count the generator's device rounds into stats['windows']."""
+    """Count the generator's device requests (one RefineRequest per
+    window attempt) into stats['windows']."""
     try:
         req = next(gen)
         while True:

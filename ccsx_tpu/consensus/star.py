@@ -130,44 +130,92 @@ class RoundRequest:
     draft: np.ndarray     # (tlen,) uint8 codes — alignment target
 
 
+@dataclasses.dataclass
+class RefineRequest:
+    """One WINDOW's entire refinement loop (iters speculative rounds +
+    the final strict round), requested as a single unit of device work.
+
+    The per-hole path satisfies it with the host loop (refine_host — the
+    spec); the batched pipeline runs it as ONE fused device dispatch
+    (pipeline/batch._refine_step) whose intermediate speculative drafts
+    never leave the chip — the dominant dispatch-count reduction of the
+    framework (one launch per window instead of iters+1)."""
+
+    qs: np.ndarray        # (P, qmax) uint8 padded passes
+    qlens: np.ndarray     # (P,) int32
+    row_mask: np.ndarray  # (P,) bool
+    draft: np.ndarray     # (tlen,) uint8 codes — initial alignment target
+    iters: int            # speculative refinement rounds before the final
+
+
+@dataclasses.dataclass
+class RefineResult:
+    """Result of one window's refinement: the final round, plus the
+    strict draft materialized LAZILY — non-final windows consume only
+    ``rr`` (materialize(upto=bp) + advance), so they never pay for the
+    full-draft materialization."""
+
+    rr: "RoundResult"     # the final round (windowed needs bp/advance)
+    _draft: "np.ndarray | None" = dataclasses.field(
+        default=None, repr=False)
+
+    @property
+    def draft(self) -> np.ndarray:
+        if self._draft is None:
+            self._draft = self.rr.materialize(speculative=False)
+        return self._draft
+
+
 def run_rounds(gen, sm: "StarMsa"):
-    """Drive a consensus generator with immediate per-hole rounds."""
+    """Drive a consensus generator with immediate per-hole device work."""
     try:
         req = next(gen)
         while True:
-            rr = sm.round(req.qs, req.qlens, req.row_mask, req.draft)
-            req = gen.send(rr)
+            if isinstance(req, RefineRequest):
+                res = refine_host(sm.round, req.qs, req.qlens,
+                                  req.row_mask, req.draft, req.iters)
+                req = gen.send(res)
+            else:
+                rr = sm.round(req.qs, req.qlens, req.row_mask, req.draft)
+                req = gen.send(rr)
     except StopIteration as e:
         return e.value
 
 
-def refine_rounds_gen(qs, qlens, row_mask, draft, iters: int,
-                      strict: bool = True):
-    """Shared refinement loop: iters speculative rounds + a strict one,
-    with a fixpoint early-exit.  Yields RoundRequests; returns
-    (draft, last RoundResult).
+def refine_host(round_fn, qs, qlens, row_mask, draft, iters: int) -> "RefineResult":
+    """THE refinement-loop spec: iters speculative rounds + a final one,
+    with a fixpoint early-exit.
 
     When a speculative round leaves the draft unchanged, a re-round on
     it would return the same RoundResult (the round is a pure function
     of its request), so the remaining speculative rounds are no-ops and
     the final strict output is this round's strict materialization —
-    the dispatches are skipped, bit-identically (tested in
-    test_consensus.py).  ``strict=False`` callers (non-final windows,
-    which consume only the RoundResult) skip the strict materialize at
-    the fixpoint."""
+    the rounds are skipped, bit-identically (tested in
+    test_consensus.py).  The strict draft itself is lazy
+    (RefineResult.draft), so callers that consume only the final round
+    never materialize it.  The fused device step replicates exactly this
+    loop (per-hole fixpoint masking included) and is differential-tested
+    against it (tests/test_refine_fused.py)."""
     rr = None
     it = 0
-    while it <= iters:
-        rr = yield RoundRequest(qs, qlens, row_mask, draft)
-        spec = it < iters
-        new_draft = rr.materialize(speculative=spec)
-        if spec and np.array_equal(new_draft, draft):
-            if strict:
-                draft = rr.materialize(speculative=False)
-            return draft, rr
+    while True:
+        rr = round_fn(qs, qlens, row_mask, draft)
+        if it == iters:
+            break
+        new_draft = rr.materialize(speculative=True)
+        if np.array_equal(new_draft, draft):
+            break
         draft = new_draft
         it += 1
-    return draft, rr
+    return RefineResult(rr=rr)
+
+
+def refine_rounds_gen(qs, qlens, row_mask, draft, iters: int):
+    """Request one window's refinement from the driving executor; returns
+    (draft, last RoundResult) like refine_host, whichever executor
+    (per-hole host loop or fused batched device step) satisfies it."""
+    res = yield RefineRequest(qs, qlens, row_mask, draft, iters)
+    return res.draft, res.rr
 
 
 @dataclasses.dataclass
@@ -252,8 +300,9 @@ class StarMsa:
 
     def consensus_gen(self, passes: List[np.ndarray], iters: int,
                       pass_buckets: Sequence[int], max_passes: int):
-        """Generator form of consensus(): yields RoundRequests, receives
-        RoundResults, returns the final draft via StopIteration.value."""
+        """Generator form of consensus(): yields one RefineRequest,
+        receives a RefineResult, returns the final draft via
+        StopIteration.value."""
         qs, qlens, row_mask = self.pack(passes, pass_buckets, max_passes)
         draft, _rr = yield from refine_rounds_gen(
             qs, qlens, row_mask, passes[0], iters)

@@ -84,8 +84,9 @@ def _advance(rr: RoundResult, bp: int) -> np.ndarray:
 
 
 def windowed_gen(passes: List[np.ndarray], cfg: CcsConfig):
-    """Generator form of consensus_windowed: yields RoundRequests, receives
-    RoundResults, returns the consensus codes via StopIteration.value."""
+    """Generator form of consensus_windowed: yields one RefineRequest per
+    window attempt, receives RefineResults, returns the consensus codes
+    via StopIteration.value."""
     sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
     if len(passes) > cfg.max_passes:
         passes = passes[: cfg.max_passes]
@@ -108,11 +109,11 @@ def windowed_gen(passes: List[np.ndarray], cfg: CcsConfig):
                            for k, p in enumerate(passes)]
             qs, qlens, row_mask = sm.pack(
                 windows, cfg.pass_buckets, cfg.max_passes)
-            # strict draft only needed on the final flush; non-final
-            # windows consume only rr (materialize(upto=bp) + advance)
+            # one RefineRequest per window attempt; non-final windows
+            # consume only rr (materialize(upto=bp) + advance), the
+            # final flush uses the strict draft
             draft, rr = yield from refine_rounds_gen(
-                qs, qlens, row_mask, windows[0], cfg.refine_iters,
-                strict=final)
+                qs, qlens, row_mask, windows[0], cfg.refine_iters)
 
             if final:
                 out.append(draft)

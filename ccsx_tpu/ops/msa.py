@@ -93,3 +93,41 @@ def materialize(cons: np.ndarray, ins_out: np.ndarray, tlen: int) -> np.ndarray:
     ins = np.asarray(ins_out)[:tlen]
     m = np.concatenate([cons[:, None], ins], axis=1).ravel()
     return m[m < 4].astype(np.uint8)
+
+
+def emit_insertions_jax(ins_base, ins_votes, ncov, speculative: bool):
+    """jnp equivalent of emit_insertions — bit-identical by construction
+    (same int arithmetic; the prefix rule is a cumprod over ranks).  Used
+    inside the fused refinement step (pipeline/batch._refine_step), where
+    the intermediate speculative drafts never leave the device."""
+    iv = jnp.asarray(ins_votes).astype(jnp.int32)
+    n = jnp.asarray(ncov).astype(jnp.int32)[:, None]
+    emit = iv * 2 > n
+    if speculative:
+        emit = emit | (iv >= jnp.maximum(2, -(-n // 3)))
+    emit = jnp.cumprod(emit.astype(jnp.int32), axis=1).astype(bool)
+    return jnp.where(emit, ins_base, jnp.uint8(PAD))
+
+
+def make_materializer(tmax_in: int, tmax_out: int, max_ins: int):
+    """Device materialize: interleave + stable-compact at static shapes.
+
+    Returns f(cons (tmax_in,), ins_out (tmax_in, max_ins), tlen) ->
+    (draft (tmax_out,) uint8 padded with PAD, newlen int32, overflow bool).
+    Bit-identical to the host materialize on the first ``newlen`` cells
+    whenever ``overflow`` is False; on overflow the tail is dropped and the
+    caller must fall back to the host path (the flag makes that exact).
+    """
+
+    def mat(cons, ins_out, tlen):
+        m = jnp.concatenate([cons[:, None], ins_out], axis=1).reshape(-1)
+        col = jnp.repeat(jnp.arange(tmax_in, dtype=jnp.int32), 1 + max_ins)
+        keep = (m < 4) & (col < tlen)
+        pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        newlen = keep.sum().astype(jnp.int32)
+        out = jnp.full((tmax_out,), jnp.uint8(PAD))
+        idx = jnp.where(keep, pos, tmax_out)  # parked writes drop below
+        out = out.at[idx].set(m.astype(jnp.uint8), mode="drop")
+        return out, newlen, newlen > tmax_out
+
+    return mat

@@ -4,17 +4,19 @@ The per-hole path (pipeline/run.py) dispatches one star-MSA round per hole
 per window — correct, but each dispatch is a small (P, W) problem that
 leaves the chip mostly idle.  This runner multiplexes the consensus
 generators (windowed_gen / consensus_gen) of many in-flight holes and
-executes their pending RoundRequests together:
+executes their pending RefineRequests together:
 
   admit holes ──> per-hole generator (host state machine)
-                    │ yields RoundRequest (P, qmax) + draft
+                    │ yields RefineRequest (one window's refinement)
                     ▼
-  group by (P, qmax, tmax) shape bucket ──> stack to (Z, P, qmax)
+  group by (P, qmax, tmax, iters) shape bucket ──> stack to (Z, P, qmax)
                     ▼
-  ONE jitted device round per group: banded DP fill (Pallas on TPU) +
-  traceback projection + column vote, vmapped over Z and P
+  ONE fused jitted dispatch per group (_refine_step): the speculative
+  refinement rounds loop on device (banded DP fill + traceback
+  projection + column vote + draft re-materialization), then the final
+  round + breakpoint scan — intermediate drafts never leave the chip
                     ▼
-  RoundResults routed back into each generator; finished holes emit
+  RefineResults routed back into each generator; finished holes emit
   consensus to the order-preserving writer.
 
 This is the TPU analog of the reference's kt_for over a chunk's ZMWs
@@ -39,12 +41,49 @@ from ccsx_tpu.consensus import prepare as prep_mod
 from ccsx_tpu.consensus.align_host import MatchResult
 from ccsx_tpu.consensus.hole import full_gen_for_zmw
 from ccsx_tpu.consensus.star import (
-    RoundRequest, RoundResult, bucket_len, pad_to,
+    RefineRequest, RefineResult, RoundRequest, RoundResult, StarMsa,
+    bucket_len, pad_to, refine_host,
 )
+from ccsx_tpu.ops import banded
 from ccsx_tpu.ops import encode as enc
 from ccsx_tpu.ops import traceback
 from ccsx_tpu.utils.journal import Journal
 from ccsx_tpu.utils.metrics import Metrics
+
+
+@functools.lru_cache(maxsize=128)
+def _round_body(params: AlignParams, max_ins: int, tmax: int):
+    """The ONE star-round body both jitted steps build on: align every
+    (hole, pass) window to its hole's draft (banded DP), project onto
+    draft coordinates, vote per column.  _round_step and _refine_step
+    share this function so the fused loop cannot drift from the
+    single-round spec the differential tests pin."""
+    from ccsx_tpu.consensus import star as star_mod
+    from ccsx_tpu.ops import msa as msa_mod
+
+    aligner = star_mod._aligner(params)  # scan default; env-gated Pallas
+    projector = traceback.make_projector(tmax, max_ins)
+    voter = msa_mod.make_voter(max_ins)
+
+    def body(qs, qlens, row_mask, draft, dlen):
+        Z, P, qmax = qs.shape
+        ts_b = jax.numpy.broadcast_to(draft[:, None, :], (Z, P, tmax))
+        tl_b = jax.numpy.broadcast_to(dlen[:, None], (Z, P))
+        _, moves, offs = aligner(
+            qs.reshape(Z * P, qmax), qlens.reshape(Z * P),
+            ts_b.reshape(Z * P, tmax), tl_b.reshape(Z * P))
+        moves = moves.reshape(Z, P, qmax, -1)
+        offs = offs.reshape(Z, P, qmax)
+        proj = jax.vmap(jax.vmap(projector, in_axes=(0, 0, 0, 0, None)),
+                        in_axes=(0, 0, 0, 0, 0))
+        aligned, ins_cnt, ins_b, lead_ins = proj(
+            moves, offs, qs, qlens, dlen)
+        cons, ins_base, ins_votes, ncov, match = jax.vmap(voter)(
+            aligned, ins_cnt, ins_b, row_mask)
+        return (cons, ins_base, ins_votes, ncov, match, aligned, ins_cnt,
+                lead_ins)
+
+    return body
 
 
 @functools.lru_cache(maxsize=128)
@@ -58,30 +97,15 @@ def _round_step(params: AlignParams, max_ins: int, tmax: int,
     (ops/breakpoint.py), so only small per-hole outputs cross to the
     host — not the (Z, P, tmax) match/aligned/ins_cnt tensors.
     """
-    from ccsx_tpu.consensus import star as star_mod
     from ccsx_tpu.ops import breakpoint as bp_mod
-    from ccsx_tpu.ops import msa as msa_mod
 
-    aligner = star_mod._aligner(params)  # scan default; env-gated Pallas
-    projector = traceback.make_projector(tmax, max_ins)
-    voter = msa_mod.make_voter(max_ins)
+    body = _round_body(params, max_ins, tmax)
     bp_advance = bp_mod.make_bp_advance(tmax, *bp_consts)
 
     @jax.jit
     def step(qs, qlens, ts, tlens, row_mask):
-        Z, P, qmax = qs.shape
-        ts_b = jax.numpy.broadcast_to(ts[:, None, :], (Z, P, tmax))
-        tl_b = jax.numpy.broadcast_to(tlens[:, None], (Z, P))
-        _, moves, offs = aligner(
-            qs.reshape(Z * P, qmax), qlens.reshape(Z * P),
-            ts_b.reshape(Z * P, tmax), tl_b.reshape(Z * P))
-        moves = moves.reshape(Z, P, qmax, -1)
-        offs = offs.reshape(Z, P, qmax)
-        proj = jax.vmap(jax.vmap(projector, in_axes=(0, 0, 0, 0, None)),
-                        in_axes=(0, 0, 0, 0, 0))
-        aligned, ins_cnt, ins_b, lead_ins = proj(moves, offs, qs, qlens, tlens)
-        cons, ins_base, ins_votes, ncov, match = jax.vmap(voter)(
-            aligned, ins_cnt, ins_b, row_mask)
+        (cons, ins_base, ins_votes, ncov, match, aligned, ins_cnt,
+         lead_ins) = body(qs, qlens, row_mask, ts, tlens)
         bp, advance = jax.vmap(bp_advance)(
             match, cons, aligned, ins_cnt, lead_ins, row_mask, tlens)
         # compact the d2h payload: votes/coverage are bounded by the pass
@@ -100,6 +124,89 @@ def _z_bucket(n: int) -> int:
     while z < n:
         z *= 2
     return z
+
+
+def _fused_tmax(tlen: int, quant: int) -> int:
+    """Draft capacity for the fused refinement step: one geometric bucket
+    above the request's own, so the speculative rounds' liberal inserts
+    (msa.emit_insertions) stay on device in the overwhelmingly common
+    case.  A draft outgrowing even that is flagged by the step and
+    replayed exactly on the host (refine_host)."""
+    b = bucket_len(tlen, quant)
+    return bucket_len(b + 1, quant)
+
+
+@functools.lru_cache(maxsize=128)
+def _refine_step(params: AlignParams, max_ins: int, tmax: int, iters: int,
+                 bp_consts: tuple):
+    """ONE jitted dispatch for a window's whole refinement loop.
+
+    Runs `iters` speculative star rounds in a device while_loop —
+    realign to draft, vote, emit insertions liberally, re-materialize
+    the draft ON DEVICE (msa.emit_insertions_jax / make_materializer) —
+    then the final round with the device breakpoint scan.  Per-hole
+    fixpoint masking mirrors refine_host's early-exit bit-exactly: a
+    hole whose speculative draft stops changing is frozen (re-rounds on
+    a fixed draft are no-ops, so freezing == the host's skip), and the
+    loop exits early once every hole is frozen.  This cuts the batched
+    pipeline's device dispatches per window from iters+1 to 1 — the
+    reference pays no such per-round launch cost (its POA rounds are
+    function calls, main.c:486-492), so this is where the TPU pipeline
+    wins back launch overhead.
+    """
+    import jax.numpy as jnp
+
+    from ccsx_tpu.ops import breakpoint as bp_mod
+    from ccsx_tpu.ops import msa as msa_mod
+
+    one_round = _round_body(params, max_ins, tmax)
+    bp_advance = bp_mod.make_bp_advance(tmax, *bp_consts)
+    mat_v = jax.vmap(msa_mod.make_materializer(tmax, tmax, max_ins))
+    spec_emit = jax.vmap(
+        lambda ib, iv, nc: msa_mod.emit_insertions_jax(ib, iv, nc, True))
+
+    @jax.jit
+    def step(qs, qlens, ts, tlens, row_mask):
+        def body(carry):
+            it, draft, dlen, fixed, ovf = carry
+            cons, ins_base, ins_votes, ncov, *_ = one_round(
+                qs, qlens, row_mask, draft, dlen)
+            ins_out = spec_emit(ins_base, ins_votes, ncov)
+            nd, nl, o = mat_v(cons, ins_out, dlen)
+            # fixpoint: same length AND same padded cells == the host's
+            # np.array_equal on the exact-length drafts (pads are PAD on
+            # both sides, and a length change forces a cell change)
+            now_fixed = (nl == dlen) & (nd == draft).all(axis=1)
+            o = ~fixed & o
+            # only non-fixed, non-overflowing holes take the new draft:
+            # an overflowed hole keeps its in-range draft/dlen and is
+            # FROZEN — its device result is discarded for a host replay,
+            # and freezing keeps the carry valid for the static shapes
+            # and stops it holding the loop open
+            grow = ~fixed & ~o
+            draft = jnp.where(grow[:, None], nd, draft)
+            dlen = jnp.where(grow, nl, dlen)
+            return it + 1, draft, dlen, fixed | now_fixed | o, ovf | o
+
+        def cond(carry):
+            it, _, _, fixed, _ = carry
+            return (it < iters) & ~fixed.all()
+
+        # pad holes (all-False row_mask) start frozen so they can't keep
+        # the while_loop alive
+        fixed0 = ~row_mask.any(axis=1)
+        ovf0 = jnp.zeros(fixed0.shape, bool)
+        _, draft, dlen, _, ovf = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), ts, tlens, fixed0, ovf0))
+        (cons, ins_base, ins_votes, ncov, match, aligned, ins_cnt,
+         lead_ins) = one_round(qs, qlens, row_mask, draft, dlen)
+        bp, advance = jax.vmap(bp_advance)(
+            match, cons, aligned, ins_cnt, lead_ins, row_mask, dlen)
+        # uint8 vote/coverage compaction, as in _round_step
+        return (cons, ins_base, ins_votes.astype(jnp.uint8),
+                ncov.astype(jnp.uint8), bp, advance, dlen, ovf)
+
+    return step
 
 
 @functools.lru_cache(maxsize=8)
@@ -200,6 +307,10 @@ class BatchExecutor:
         self.cfg = cfg
         self.len_quant = cfg.len_bucket_quant
         self.metrics = metrics
+        # host-replay spec for fused-refine overflows (rare): the exact
+        # per-hole loop the fused step mirrors
+        self._sm = StarMsa(cfg.align, cfg.max_ins_per_col,
+                           cfg.len_bucket_quant)
         self._mesh = None
         n_dev = len(jax.devices())
         if n_dev > 1:
@@ -253,8 +364,75 @@ class BatchExecutor:
                 f"mesh {shape} needs {need} devices, host has {n_dev}")
         return shape
 
-    def run(self, requests: List[RoundRequest]) -> List[RoundResult]:
-        """Satisfy all requests; results align index-for-index."""
+    def _bp_consts(self):
+        cfg = self.cfg
+        return (cfg.bp_window, cfg.bp_minwin, cfg.bp_rowrate,
+                cfg.bp_colrate, cfg.bp_colrate_lowpass)
+
+    def _shard_args(self, args, P: int):
+        """device_put the 5 round/refine inputs with the (data, pass)
+        NamedShardings (GSPMD partitions the jitted step from these)."""
+        if self._mesh is None:
+            return args
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as PS
+
+        # replicate the pass axis when the bucket doesn't divide
+        pax = "pass" if P % self._pass_dim == 0 else None
+        specs = (PS("data", pax, None), PS("data", pax),
+                 PS("data", None), PS("data"), PS("data", pax))
+        return tuple(jax.device_put(a, NamedSharding(self._mesh, s))
+                     for a, s in zip(args, specs))
+
+    def _round_z(self, n: int) -> int:
+        Z = _z_bucket(n)
+        if self._mesh is not None:
+            # the data-axis sharding needs Z divisible by the data
+            # dimension (power-of-two Z alone is not enough when it
+            # isn't a power of two, e.g. 6 or 12 devices)
+            Z = -(-Z // self._data_dim) * self._data_dim
+        return Z
+
+    def _stack_group(self, reqs, idxs, P, qmax, tmax):
+        """Pad + stack a shape group's requests into device inputs."""
+        Z = self._round_z(len(idxs))
+        qs = np.zeros((Z, P, qmax), np.uint8)
+        qlens = np.zeros((Z, P), np.int32)
+        ts = np.full((Z, tmax), banded.PAD, np.uint8)
+        ts[:, 0] = 0                     # pad holes: 1-col no-op drafts
+        tlens = np.ones((Z,), np.int32)
+        row_mask = np.zeros((Z, P), bool)
+        for z, i in enumerate(idxs):
+            req = reqs[i]
+            qs[z] = req.qs
+            qlens[z] = req.qlens
+            ts[z] = pad_to(req.draft, tmax)
+            tlens[z] = len(req.draft)
+            row_mask[z] = req.row_mask
+        return qs, qlens, ts, tlens, row_mask
+
+    def run(self, requests) -> list:
+        """Satisfy all requests (RefineRequest — the production window
+        protocol — and/or bare RoundRequest); results align
+        index-for-index (RefineResult / RoundResult respectively)."""
+        results: List[object] = [None] * len(requests)
+        refine = [i for i, r in enumerate(requests)
+                  if isinstance(r, RefineRequest)]
+        rounds = [i for i, r in enumerate(requests)
+                  if not isinstance(r, RefineRequest)]
+        if refine:
+            for i, res in zip(refine,
+                              self._run_refine([requests[i]
+                                                for i in refine])):
+                results[i] = res
+        if rounds:
+            for i, res in zip(rounds,
+                              self._run_rounds([requests[i]
+                                                for i in rounds])):
+                results[i] = res
+        return results
+
+    def _run_rounds(self, requests: List[RoundRequest]) -> List[RoundResult]:
         cfg = self.cfg
         groups: Dict[tuple, List[int]] = defaultdict(list)
         for i, req in enumerate(requests):
@@ -264,45 +442,14 @@ class BatchExecutor:
 
         results: List[Optional[RoundResult]] = [None] * len(requests)
         if self.metrics is not None:
-            self.metrics.windows += len(requests)
+            # bare rounds (legacy/test path) count as dispatches only —
+            # 'windows' counts RefineRequests (one per window attempt)
             self.metrics.device_dispatches += len(groups)
         for (P, qmax, tmax), idxs in groups.items():
-            n = len(idxs)
-            Z = _z_bucket(n)
-            if self._mesh is not None:
-                # the data-axis sharding needs Z divisible by the data
-                # dimension (power-of-two Z alone is not enough when it
-                # isn't a power of two, e.g. 6 or 12 devices)
-                Z = -(-Z // self._data_dim) * self._data_dim
-            qs = np.zeros((Z, P, qmax), np.uint8)
-            qlens = np.zeros((Z, P), np.int32)
-            ts = np.zeros((Z, tmax), np.uint8)
-            tlens = np.ones((Z,), np.int32)  # pad holes: 1-col no-op drafts
-            row_mask = np.zeros((Z, P), bool)
-            for z, i in enumerate(idxs):
-                req = requests[i]
-                qs[z] = req.qs
-                qlens[z] = req.qlens
-                ts[z] = pad_to(req.draft, tmax)
-                tlens[z] = len(req.draft)
-                row_mask[z] = req.row_mask
+            args = self._stack_group(requests, idxs, P, qmax, tmax)
             step = _round_step(cfg.align, cfg.max_ins_per_col, tmax,
-                               (cfg.bp_window, cfg.bp_minwin,
-                                cfg.bp_rowrate, cfg.bp_colrate,
-                                cfg.bp_colrate_lowpass))
-            args = (qs, qlens, ts, tlens, row_mask)
-            if self._mesh is not None:
-                from jax.sharding import NamedSharding
-                from jax.sharding import PartitionSpec as PS
-
-                # replicate the pass axis when the bucket doesn't divide
-                pax = "pass" if P % self._pass_dim == 0 else None
-                specs = (PS("data", pax, None), PS("data", pax),
-                         PS("data", None), PS("data"), PS("data", pax))
-                args = tuple(
-                    jax.device_put(a, NamedSharding(self._mesh, s))
-                    for a, s in zip(args, specs))
-            out = step(*args)
+                               self._bp_consts())
+            out = step(*self._shard_args(args, P))
             (cons, ins_base, ins_votes, ncov, bp, advance) = (
                 np.asarray(o) for o in out)
             for z, i in enumerate(idxs):
@@ -314,13 +461,54 @@ class BatchExecutor:
                 )
         return results
 
+    def _run_refine(self, requests: List[RefineRequest]) -> List[RefineResult]:
+        """One fused device dispatch per shape group for whole-window
+        refinement loops (see _refine_step).  A hole whose speculative
+        draft outgrows the fused capacity (_fused_tmax) is replayed
+        exactly on the host — the overflow flag makes the fallback
+        bit-faithful, and the counter records how rare it is."""
+        cfg = self.cfg
+        groups: Dict[tuple, List[int]] = defaultdict(list)
+        for i, req in enumerate(requests):
+            P, qmax = req.qs.shape
+            tmax = _fused_tmax(len(req.draft), self.len_quant)
+            groups[(P, qmax, tmax, req.iters)].append(i)
+
+        results: List[Optional[RefineResult]] = [None] * len(requests)
+        if self.metrics is not None:
+            self.metrics.windows += len(requests)
+            self.metrics.device_dispatches += len(groups)
+        for (P, qmax, tmax, iters), idxs in groups.items():
+            args = self._stack_group(requests, idxs, P, qmax, tmax)
+            step = _refine_step(cfg.align, cfg.max_ins_per_col, tmax,
+                                iters, self._bp_consts())
+            out = step(*self._shard_args(args, P))
+            (cons, ins_base, ins_votes, ncov, bp, advance, dlen, ovf) = (
+                np.asarray(o) for o in out)
+            for z, i in enumerate(idxs):
+                req = requests[i]
+                if ovf[z]:
+                    if self.metrics is not None:
+                        self.metrics.refine_overflows += 1
+                    results[i] = refine_host(
+                        self._sm.round, req.qs, req.qlens, req.row_mask,
+                        req.draft, req.iters)
+                    continue
+                rr = RoundResult(
+                    cons=cons[z], ins_base=ins_base[z],
+                    ins_votes=ins_votes[z], ncov=ncov[z],
+                    tlen=int(dlen[z]), bp=int(bp[z]), advance=advance[z],
+                )
+                results[i] = RefineResult(rr=rr)
+        return results
+
 
 @dataclasses.dataclass
 class _Hole:
     idx: int
     zmw: object
     gen: object = None         # consensus generator (None => skipped)
-    req: RoundRequest = None   # pending device work
+    req: object = None         # pending PairRequest | RefineRequest
     done: bool = False
     resumed: bool = False      # written by a previous run; skip + no journal
     cns: Optional[bytes] = None
@@ -329,7 +517,7 @@ class _Hole:
 
 def _start_hole(hole: _Hole, cfg: CcsConfig) -> None:
     """Start the combined prep+consensus generator (first step only;
-    PairRequests and RoundRequests both flow through the driver)."""
+    PairRequests and RefineRequests both flow through the driver)."""
     try:
         hole.gen = full_gen_for_zmw(hole.zmw, cfg)
         hole.req = next(hole.gen)
@@ -340,7 +528,8 @@ def _start_hole(hole: _Hole, cfg: CcsConfig) -> None:
         hole.done, hole.err = True, e
 
 
-def _advance_hole(hole: _Hole, rr: RoundResult) -> None:
+def _advance_hole(hole: _Hole, rr) -> None:
+    """Feed the matching result (MatchResult / RefineResult) back in."""
     try:
         hole.req = hole.gen.send(rr)
     except StopIteration as e:

@@ -25,6 +25,7 @@ class Metrics:
     windows: int = 0
     pair_alignments: int = 0   # batched prep strand_match pairs
     device_dispatches: int = 0
+    refine_overflows: int = 0  # fused windows replayed on host (rare)
     # per-stage wall time (SURVEY.md §5.1: the reference has no stage
     # timing; the pipeline analog of its read/compute/write steps).
     # Attribution is at the driver loop: with worker threads, t_compute
@@ -75,6 +76,7 @@ class Metrics:
             "windows": self.windows,
             "pair_alignments": self.pair_alignments,
             "device_dispatches": self.device_dispatches,
+            "refine_overflows": self.refine_overflows,
             "ingest_s": round(self.t_ingest, 6),
             "prep_s": round(self.t_prep, 6),
             "compute_s": round(self.t_compute, 6),

@@ -47,8 +47,9 @@ def test_stage_timers_and_windows_are_written(tmp_path, rng, batch):
     assert final["ingest_s"] > 0
     assert final["compute_s"] > 0
     assert final["write_s"] > 0
-    # each hole runs >= refine_iters+1 device rounds
-    assert final["windows"] >= 3 * (CcsConfig.refine_iters + 1)
+    # each hole runs >= 1 window refinement (the unit of device work
+    # since the fused-refine protocol: one RefineRequest per window)
+    assert final["windows"] >= 3
     assert final["device_dispatches"] > 0
 
 

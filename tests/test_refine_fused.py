@@ -1,0 +1,119 @@
+"""Fused refinement step (pipeline/batch._refine_step): bit-parity with
+the host refinement loop (star.refine_host — the spec), per-hole fixpoint
+masking, and the overflow -> host-replay fallback."""
+
+import numpy as np
+import pytest
+
+from ccsx_tpu.config import CcsConfig
+from ccsx_tpu.consensus import windowed as win_mod
+from ccsx_tpu.consensus.star import RefineRequest, StarMsa, refine_host
+from ccsx_tpu.pipeline import batch as batch_mod
+from ccsx_tpu.pipeline.batch import BatchExecutor
+from ccsx_tpu.utils import synth
+from ccsx_tpu.utils.metrics import Metrics
+
+
+def _requests(rng, cfg, specs):
+    """Build RefineRequests for (n_passes, tlen, err) hole specs; includes
+    error-free holes so the fixpoint early-exit path is exercised."""
+    sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
+    reqs = []
+    for n, tlen, err in specs:
+        tpl = rng.integers(0, 4, tlen).astype(np.uint8)
+        if err == 0.0:
+            ps = [tpl.copy() for _ in range(n)]
+        else:
+            ps = [synth.mutate(rng, tpl, err / 3, err / 3, err / 3)
+                  for _ in range(n)]
+        qs, qlens, row_mask = sm.pack(ps, cfg.pass_buckets, cfg.max_passes)
+        reqs.append(RefineRequest(qs, qlens, row_mask, ps[0],
+                                  cfg.refine_iters))
+    return sm, reqs
+
+
+def _assert_matches_host(sm, cfg, req, res):
+    want = refine_host(
+        sm.round, req.qs, req.qlens, req.row_mask, req.draft, req.iters)
+    want_rr = want.rr
+    np.testing.assert_array_equal(res.draft, want.draft)
+    rr = res.rr
+    assert rr.tlen == want_rr.tlen
+    T = rr.tlen
+    np.testing.assert_array_equal(rr.cons[:T], want_rr.cons[:T])
+    np.testing.assert_array_equal(rr.ins_base[:T], want_rr.ins_base[:T])
+    np.testing.assert_array_equal(rr.ins_votes[:T], want_rr.ins_votes[:T])
+    np.testing.assert_array_equal(rr.ncov[:T], want_rr.ncov[:T])
+    # device breakpoint/advance vs the host spec on the host result
+    nseq = int(req.row_mask.sum())
+    host_bp = win_mod.find_breakpoint(want_rr, nseq, cfg)
+    if rr.bp is not None:  # host-replayed results carry bp=None
+        assert (rr.bp if rr.bp >= 1 else None) == host_bp
+        bp_eff = host_bp if host_bp is not None else max(T - cfg.bp_window, 1)
+        np.testing.assert_array_equal(
+            rr.advance, win_mod._advance(want_rr, bp_eff).astype(np.int32))
+        # the windowed consumer's actual slice must agree too
+        if host_bp is not None:
+            np.testing.assert_array_equal(
+                rr.materialize(upto=host_bp),
+                want_rr.materialize(upto=host_bp))
+
+
+def test_fused_refine_matches_host_loop(rng):
+    """One fused dispatch == the host refinement loop, bitwise, across
+    mixed shapes, pass counts, noise levels, and fixpoint holes."""
+    cfg = CcsConfig(is_bam=False)
+    specs = [(3, 500, 0.12), (5, 700, 0.06), (4, 500, 0.0),
+             (9, 1100, 0.12), (6, 700, 0.3)]
+    sm, reqs = _requests(rng, cfg, specs)
+    metrics = Metrics()
+    results = BatchExecutor(cfg, metrics=metrics).run(reqs)
+    for req, res in zip(reqs, results):
+        _assert_matches_host(sm, cfg, req, res)
+    # every window was satisfied by fused dispatches, not host replay
+    assert metrics.refine_overflows == 0
+    assert metrics.windows == len(reqs)
+
+
+@pytest.mark.parametrize("mesh", [(4, 2), (8, 1)])
+def test_fused_refine_under_mesh(rng, mesh):
+    """The fused while_loop must survive GSPMD partitioning over the
+    (data, pass) mesh bit-exactly (psums inside a while_loop body)."""
+    cfg = CcsConfig(is_bam=False, mesh_shape=mesh)
+    specs = [(5, 600, 0.1), (7, 900, 0.1), (6, 600, 0.0)]
+    sm, reqs = _requests(rng, cfg, specs)
+    results = BatchExecutor(cfg).run(reqs)
+    for req, res in zip(reqs, results):
+        _assert_matches_host(sm, cfg, req, res)
+
+
+def test_fused_refine_overflow_replays_on_host(rng, monkeypatch):
+    """With the fused draft capacity pinned to the request bucket (no
+    growth headroom), insert-heavy holes overflow on device and must be
+    replayed on the host — bit-faithfully, and counted."""
+    cfg = CcsConfig(is_bam=False)
+    sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
+    # a draft with every 4th template base deleted (450 of 600 bases,
+    # bucket 512) against unanimous full-length passes: round 1 re-grows
+    # the draft to ~600 — past the pinned capacity
+    tpl = rng.integers(0, 4, 600).astype(np.uint8)
+    draft = tpl[np.arange(600) % 4 != 3]
+    ps = [tpl.copy() for _ in range(6)]
+    qs, qlens, row_mask = sm.pack(ps, cfg.pass_buckets, cfg.max_passes)
+    req = RefineRequest(qs, qlens, row_mask, draft, cfg.refine_iters)
+
+    monkeypatch.setattr(batch_mod, "_fused_tmax",
+                        lambda tlen, quant: batch_mod.bucket_len(tlen, quant))
+    metrics = Metrics()
+    res = BatchExecutor(cfg, metrics=metrics).run([req])[0]
+    assert metrics.refine_overflows >= 1
+    _assert_matches_host(sm, cfg, req, res)
+
+
+def test_fused_tmax_headroom():
+    from ccsx_tpu.consensus.star import bucket_len
+
+    for tlen in (100, 512, 700, 2000, 2048):
+        b = bucket_len(tlen, 512)
+        f = batch_mod._fused_tmax(tlen, 512)
+        assert f > b  # always at least one geometric step of growth room
