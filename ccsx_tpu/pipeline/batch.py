@@ -261,6 +261,7 @@ class PairExecutor:
             self.metrics.pair_alignments += len(lines)
             self.metrics.device_dispatches += len(groups)
         fill = _pair_fill(self.params)
+        pending = []
         for (qmax, tmax), idxs in groups.items():
             N = _z_bucket(len(idxs))
             qs = np.stack([pad_to(pairs[i].q, qmax) for i in idxs]
@@ -276,7 +277,9 @@ class PairExecutor:
                 qlens[z] = len(pairs[i].q)
                 tlens[z] = len(pairs[i].t)
                 ls[z] = lines[i]
-            res = fill(qs, qlens, ts, tlens, ls)
+            # async-dispatch every bucket before reading any back
+            pending.append((idxs, fill(qs, qlens, ts, tlens, ls)))
+        for idxs, res in pending:
             score = np.asarray(res.score)
             qb, qe = np.asarray(res.qb), np.asarray(res.qe)
             tb, te = np.asarray(res.tb), np.asarray(res.te)
@@ -445,11 +448,16 @@ class BatchExecutor:
             # bare rounds (legacy/test path) count as dispatches only —
             # 'windows' counts RefineRequests (one per window attempt)
             self.metrics.device_dispatches += len(groups)
+        # dispatch every group's device work before materializing any
+        # result: jit dispatch is async, so group B's compute overlaps
+        # group A's d2h transfer
+        pending = []
         for (P, qmax, tmax), idxs in groups.items():
             args = self._stack_group(requests, idxs, P, qmax, tmax)
             step = _round_step(cfg.align, cfg.max_ins_per_col, tmax,
                                self._bp_consts())
-            out = step(*self._shard_args(args, P))
+            pending.append((idxs, step(*self._shard_args(args, P))))
+        for idxs, out in pending:
             (cons, ins_base, ins_votes, ncov, bp, advance) = (
                 np.asarray(o) for o in out)
             for z, i in enumerate(idxs):
@@ -478,11 +486,14 @@ class BatchExecutor:
         if self.metrics is not None:
             self.metrics.windows += len(requests)
             self.metrics.device_dispatches += len(groups)
+        # async-dispatch all groups, then materialize (see _run_rounds)
+        pending = []
         for (P, qmax, tmax, iters), idxs in groups.items():
             args = self._stack_group(requests, idxs, P, qmax, tmax)
             step = _refine_step(cfg.align, cfg.max_ins_per_col, tmax,
                                 iters, self._bp_consts())
-            out = step(*self._shard_args(args, P))
+            pending.append((idxs, step(*self._shard_args(args, P))))
+        for idxs, out in pending:
             (cons, ins_base, ins_votes, ncov, bp, advance, dlen, ovf) = (
                 np.asarray(o) for o in out)
             for z, i in enumerate(idxs):
