@@ -63,7 +63,7 @@ def measure():
         proj = jax.vmap(jax.vmap(projector, in_axes=(0, 0, 0, 0, None)),
                         in_axes=(0, 0, 0, 0, 0))
         aligned, ins_cnt, ins_b, _lead = proj(moves, offs, qs, qlens, tlens)
-        cons, ins_base, ins_votes, ncov, match = jax.vmap(voter)(
+        cons, ins_base, ins_votes, ncov, match, nwin = jax.vmap(voter)(
             aligned, ins_cnt, ins_b, row_mask)
         return cons, ncov
 

@@ -87,6 +87,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "4,2); default: all devices on the data axis")
     p.add_argument("--refine-iters", type=int, default=2)
     p.add_argument("--max-passes", type=int, default=32)
+    p.add_argument("--fastq", action="store_true", dest="fastq",
+                   help="Write FASTQ with per-base vote-margin qualities "
+                        "instead of FASTA (extension; the reference "
+                        "emits FASTA only)")
     p.add_argument("--window-growth", default="flush",
                    choices=["flush", "grow"],
                    help="When no breakpoint is found at max-window: "
@@ -149,6 +153,7 @@ def config_from_args(args) -> CcsConfig:
         verbose=args.verbose,
         refine_iters=args.refine_iters,
         max_passes=args.max_passes,
+        emit_quality=args.fastq,
         window_growth=args.window_growth,
         mesh_shape=mesh_shape,
         device=args.device,

@@ -70,6 +70,15 @@ class CcsConfig:
     #   intermediate rounds use liberal-insert/strict-delete (ops/msa.py)
     max_ins_per_col: int = 4           # inserted bases stored per (pass, template col)
 
+    # ---- per-base quality output (extension; the reference writes FASTA
+    #      only, main.c:714 — no qualities exist to compare against) ----
+    emit_quality: bool = False         # CLI --fastq: write FASTQ with
+    #   vote-margin Phred qualities (star.RoundResult.materialize_with_qual)
+    qv_per_net_vote: float = 2.5       # Phred per net agreeing vote, fitted
+    #   to the measured pass-count->identity profile (BASELINE.md)
+    qv_cap: int = 60                   # quality ceiling (vote margins with
+    #   <=64 passes justify no more)
+
     # ---- alignment scoring ----
     align: AlignParams = dataclasses.field(default_factory=AlignParams)
 

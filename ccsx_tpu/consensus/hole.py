@@ -32,7 +32,9 @@ def _consensus_gen_for_passes(passes, zmw, cfg: CcsConfig):
     else:
         sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
         gen = sm.consensus_gen(
-            passes, cfg.refine_iters, cfg.pass_buckets, cfg.max_passes)
+            passes, cfg.refine_iters, cfg.pass_buckets, cfg.max_passes,
+            quality=((cfg.qv_per_net_vote, cfg.qv_cap)
+                     if cfg.emit_quality else None))
     if cfg.verbose >= 2:
         gen = _traced(gen, f"{zmw.movie}/{zmw.hole}")
     return gen
@@ -80,11 +82,14 @@ def _counted(gen, stats: dict):
 
 
 def ccs_hole(zmw, aligner, cfg: CcsConfig,
-             stats: Optional[dict] = None) -> Optional[bytes]:
+             stats: Optional[dict] = None):
     """Per-hole path: run the hole's generator with immediate rounds.
+    Returns (seq_bytes, qual_bytes|None) per encode.to_record, or None
+    for a skipped hole.
 
-    stats, if given, receives per-hole counters ('windows': device rounds
-    run) so the driver can aggregate them thread-safely on its own side.
+    stats, if given, receives per-hole counters ('windows': window
+    refinements run) so the driver can aggregate them thread-safely on
+    its own side.
     """
     gen = consensus_gen_for_zmw(zmw, aligner, cfg)
     if gen is None:
@@ -92,5 +97,4 @@ def ccs_hole(zmw, aligner, cfg: CcsConfig,
     if stats is not None:
         gen = _counted(gen, stats)
     sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
-    codes = run_rounds(gen, sm)
-    return enc.decode(codes).encode()
+    return enc.to_record(run_rounds(gen, sm))

@@ -212,10 +212,11 @@ def refine_host(round_fn, qs, qlens, row_mask, draft, iters: int) -> "RefineResu
 
 def refine_rounds_gen(qs, qlens, row_mask, draft, iters: int):
     """Request one window's refinement from the driving executor; returns
-    (draft, last RoundResult) like refine_host, whichever executor
-    (per-hole host loop or fused batched device step) satisfies it."""
+    the RefineResult (final round + lazy strict draft), whichever
+    executor (per-hole host loop or fused batched device step)
+    satisfies it."""
     res = yield RefineRequest(qs, qlens, row_mask, draft, iters)
-    return res.draft, res.rr
+    return res
 
 
 @dataclasses.dataclass
@@ -234,6 +235,7 @@ class RoundResult:
     ins_votes: np.ndarray  # (T, R) int32 supporting passes per slot/rank
     ncov: np.ndarray      # (T,) int32 covering passes
     tlen: int
+    nwin: np.ndarray | None = None     # (T,) int32 winning-cell votes
     match: np.ndarray | None = None    # (P, T) bool: pass matches consensus
     aligned: np.ndarray | None = None  # (P, T) uint8 projection
     ins_cnt: np.ndarray | None = None  # (P, T) int32 insertion counts
@@ -249,6 +251,38 @@ class RoundResult:
                     speculative: bool = False) -> np.ndarray:
         n = self.tlen if upto is None else upto
         return msa.materialize(self.cons, self.ins_out(speculative), n)
+
+    def materialize_with_qual(self, upto: int | None = None,
+                              speculative: bool = False,
+                              qv_per_net_vote: float = 2.5,
+                              qmax: int = 60):
+        """(codes, quals): the materialized consensus plus a per-base
+        Phred-scale confidence from the vote margin.
+
+        Q = clip(round(qv_per_net_vote * (supporting - dissenting)), 1,
+        qmax), where a base column's support is nwin (passes voting the
+        winning cell) out of ncov covering passes, and an insertion
+        column's is its ins_votes rank count.  qv_per_net_vote=2.5 is
+        fitted to the measured pass-count -> consensus-identity profile
+        (BASELINE.md): unanimous 6/10/16-pass columns map to ~Q15/25/40,
+        tracking the measured Q21/Q27/Q37.  This is a vote-margin
+        confidence, NOT a calibrated HiFi QV model; the reference emits
+        no qualities at all (FASTA only, main.c:714).
+        """
+        n = self.tlen if upto is None else upto
+        ins = self.ins_out(speculative)
+        cons = np.asarray(self.cons)[:n]
+        m = np.concatenate([cons[:, None], np.asarray(ins)[:n]],
+                           axis=1)
+        ncov = np.asarray(self.ncov).astype(np.int32)[:n, None]
+        support = np.concatenate(
+            [np.asarray(self.nwin).astype(np.int32)[:n, None],
+             np.asarray(self.ins_votes).astype(np.int32)[:n]], axis=1)
+        net = 2 * support - ncov
+        q = np.clip(np.rint(qv_per_net_vote * net), 1, qmax
+                    ).astype(np.uint8)
+        keep = m.ravel() < 4
+        return (m.ravel()[keep].astype(np.uint8), q.ravel()[keep])
 
 
 class StarMsa:
@@ -273,12 +307,13 @@ class StarMsa:
         _, moves, offs = aligner(qs, qlens, ts, tlens)
         aligned, ins_cnt, ins_b, lead_ins = projector_b(
             moves, offs, qs, qlens, np.int32(tlen))
-        cons, ins_base, ins_votes, ncov, match = voter(
+        cons, ins_base, ins_votes, ncov, match, nwin = voter(
             aligned, ins_cnt, ins_b, row_mask)
         return RoundResult(
             cons=np.asarray(cons), ins_base=np.asarray(ins_base),
             ins_votes=np.asarray(ins_votes),
-            ncov=np.asarray(ncov), match=np.asarray(match),
+            ncov=np.asarray(ncov), nwin=np.asarray(nwin),
+            match=np.asarray(match),
             aligned=np.asarray(aligned), ins_cnt=np.asarray(ins_cnt),
             lead_ins=np.asarray(lead_ins), tlen=tlen,
         )
@@ -299,18 +334,26 @@ class StarMsa:
         return qs, qlens, qlens > 0
 
     def consensus_gen(self, passes: List[np.ndarray], iters: int,
-                      pass_buckets: Sequence[int], max_passes: int):
+                      pass_buckets: Sequence[int], max_passes: int,
+                      quality: "tuple | None" = None):
         """Generator form of consensus(): yields one RefineRequest,
-        receives a RefineResult, returns the final draft via
-        StopIteration.value."""
+        receives a RefineResult, returns the final draft — or
+        (draft, phred_quals) when ``quality=(qv_per_net_vote, qv_cap)``
+        — via StopIteration.value."""
         qs, qlens, row_mask = self.pack(passes, pass_buckets, max_passes)
-        draft, _rr = yield from refine_rounds_gen(
+        res = yield from refine_rounds_gen(
             qs, qlens, row_mask, passes[0], iters)
-        return draft
+        if quality is not None:
+            return res.rr.materialize_with_qual(
+                speculative=False, qv_per_net_vote=quality[0],
+                qmax=quality[1])
+        return res.draft
 
     def consensus(self, passes: List[np.ndarray], iters: int,
-                  pass_buckets: Sequence[int], max_passes: int) -> np.ndarray:
+                  pass_buckets: Sequence[int], max_passes: int,
+                  quality: "tuple | None" = None):
         """iters+1 rounds; intermediate rounds insert speculatively (see
         msa.emit_insertions), the final round applies strict majority."""
         return run_rounds(
-            self.consensus_gen(passes, iters, pass_buckets, max_passes), self)
+            self.consensus_gen(passes, iters, pass_buckets, max_passes,
+                               quality), self)

@@ -21,18 +21,22 @@ from ccsx_tpu.consensus.star import StarMsa
 from ccsx_tpu.ops import encode as enc
 
 
-def consensus_passes(passes: List[np.ndarray], cfg: CcsConfig) -> np.ndarray:
-    """Consensus of oriented pass code arrays; passes[0] is the anchor."""
+def consensus_passes(passes: List[np.ndarray], cfg: CcsConfig):
+    """Consensus of oriented pass code arrays; passes[0] is the anchor.
+    Returns codes, or (codes, phred_quals) under cfg.emit_quality."""
     sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
     return sm.consensus(passes, cfg.refine_iters, cfg.pass_buckets,
-                        cfg.max_passes)
+                        cfg.max_passes,
+                        quality=((cfg.qv_per_net_vote, cfg.qv_cap)
+                                 if cfg.emit_quality else None))
 
 
-def ccs_whole_read(zmw, aligner, cfg: CcsConfig) -> Optional[bytes]:
+def ccs_whole_read(zmw, aligner, cfg: CcsConfig):
     """Full `-P` path for one ZMW (ccs_for, main.c:455-508): prepare ->
-    orient -> star-MSA consensus.  Returns ASCII consensus or None."""
+    orient -> star-MSA consensus.  Returns (seq_bytes, qual_bytes|None)
+    per encode.to_record — the same contract as hole.ccs_hole — or
+    None."""
     passes = prep.oriented_passes(zmw, aligner, cfg)
     if passes is None:  # main.c:460
         return None
-    cns = consensus_passes(passes, cfg)
-    return enc.decode(cns).encode()
+    return enc.to_record(consensus_passes(passes, cfg))

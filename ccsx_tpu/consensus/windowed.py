@@ -86,7 +86,8 @@ def _advance(rr: RoundResult, bp: int) -> np.ndarray:
 def windowed_gen(passes: List[np.ndarray], cfg: CcsConfig):
     """Generator form of consensus_windowed: yields one RefineRequest per
     window attempt, receives RefineResults, returns the consensus codes
-    via StopIteration.value."""
+    (or (codes, phred_quals) with cfg.emit_quality) via
+    StopIteration.value."""
     sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
     if len(passes) > cfg.max_passes:
         passes = passes[: cfg.max_passes]
@@ -94,6 +95,17 @@ def windowed_gen(passes: List[np.ndarray], cfg: CcsConfig):
     pos = np.zeros(nseq, dtype=np.int64)
     lens = np.array([len(p) for p in passes], dtype=np.int64)
     out: List[np.ndarray] = []
+    outq: List[np.ndarray] = []
+
+    def emit(rr: RoundResult, upto=None, speculative=False):
+        if not cfg.emit_quality:
+            out.append(rr.materialize(upto=upto, speculative=speculative))
+            return
+        c, q = rr.materialize_with_qual(
+            upto=upto, speculative=speculative,
+            qv_per_net_vote=cfg.qv_per_net_vote, qmax=cfg.qv_cap)
+        out.append(c)
+        outq.append(q)
 
     flag = True
     while flag:
@@ -111,12 +123,15 @@ def windowed_gen(passes: List[np.ndarray], cfg: CcsConfig):
                 windows, cfg.pass_buckets, cfg.max_passes)
             # one RefineRequest per window attempt; non-final windows
             # consume only rr (materialize(upto=bp) + advance), the
-            # final flush uses the strict draft
-            draft, rr = yield from refine_rounds_gen(
+            # final flush materializes the strict draft
+            res = yield from refine_rounds_gen(
                 qs, qlens, row_mask, windows[0], cfg.refine_iters)
+            rr = res.rr
 
             if final:
-                out.append(draft)
+                # the strict materialization of the final round — emit()
+                # with speculative=False produces exactly `draft`
+                emit(rr, speculative=False)
                 flag = False
                 break
 
@@ -146,7 +161,7 @@ def windowed_gen(passes: List[np.ndarray], cfg: CcsConfig):
                 # reference's unbounded growth; disable via
                 # window_growth="grow")
                 bp = max(rr.tlen - cfg.bp_window, 1)
-            out.append(rr.materialize(upto=bp))
+            emit(rr, upto=bp)
             if rr.advance is not None:
                 # device advance was computed at this same bp_eff
                 pos += rr.advance[:nseq].astype(np.int64)
@@ -154,7 +169,11 @@ def windowed_gen(passes: List[np.ndarray], cfg: CcsConfig):
                 pos += _advance(rr, bp)[:nseq]  # drop pass-bucket padding
             break
 
-    return np.concatenate(out) if out else np.zeros(0, np.uint8)
+    codes = np.concatenate(out) if out else np.zeros(0, np.uint8)
+    if not cfg.emit_quality:
+        return codes
+    quals = np.concatenate(outq) if outq else np.zeros(0, np.uint8)
+    return codes, quals
 
 
 def consensus_windowed(passes: List[np.ndarray], cfg: CcsConfig) -> np.ndarray:
@@ -163,11 +182,11 @@ def consensus_windowed(passes: List[np.ndarray], cfg: CcsConfig) -> np.ndarray:
     return run_rounds(windowed_gen(passes, cfg), sm)
 
 
-def ccs_windowed(zmw, aligner, cfg: CcsConfig) -> Optional[bytes]:
+def ccs_windowed(zmw, aligner, cfg: CcsConfig):
     """Full default path for one ZMW (ccs_for2): prepare -> orient ->
-    windowed star consensus."""
+    windowed star consensus.  Returns (seq_bytes, qual_bytes|None) per
+    encode.to_record — the same contract as hole.ccs_hole — or None."""
     passes = prep.oriented_passes(zmw, aligner, cfg)
     if passes is None:  # main.c:515
         return None
-    cns = consensus_windowed(passes, cfg)
-    return enc.decode(cns).encode()
+    return enc.to_record(consensus_windowed(passes, cfg))

@@ -74,6 +74,10 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.ccsx_writer_put_fasta.restype = c.c_int
     lib.ccsx_writer_put_fasta.argtypes = [c.c_void_p, c.c_char_p,
                                           c.POINTER(c.c_uint8), c.c_int64]
+    lib.ccsx_writer_put_fastq.restype = c.c_int
+    lib.ccsx_writer_put_fastq.argtypes = [c.c_void_p, c.c_char_p,
+                                          c.POINTER(c.c_uint8),
+                                          c.POINTER(c.c_uint8), c.c_int64]
     lib.ccsx_writer_close.restype = c.c_int
     lib.ccsx_writer_close.argtypes = [c.c_void_p]
     lib.ccsx_align_scalar.restype = c.c_int

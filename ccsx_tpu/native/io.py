@@ -142,13 +142,28 @@ class NativeFastaWriter:
         if not self._h:
             raise OSError(f"cannot open {path!r} for write")
 
-    def put(self, name: str, seq: bytes) -> None:
+    def put(self, name: str, seq: bytes, qual: bytes | None = None) -> None:
+        """FASTA record, or FASTQ when ``qual`` (phred+33 ASCII, same
+        length as seq) is given."""
         if not self._h:
             raise ValueError("writer is closed")
-        rc = self._L.ccsx_writer_put_fasta(
-            self._h, name.encode(),
-            ctypes.cast(ctypes.c_char_p(seq),
-                        ctypes.POINTER(ctypes.c_uint8)), len(seq))
+        if qual is not None and len(qual) != len(seq):
+            # the C side appends len(qual) bytes from BOTH buffers; a
+            # mismatch must fail here, not as a native over-read
+            raise ValueError(
+                f"qual length {len(qual)} != seq length {len(seq)}")
+        if qual is None:
+            rc = self._L.ccsx_writer_put_fasta(
+                self._h, name.encode(),
+                ctypes.cast(ctypes.c_char_p(seq),
+                            ctypes.POINTER(ctypes.c_uint8)), len(seq))
+        else:
+            rc = self._L.ccsx_writer_put_fastq(
+                self._h, name.encode(),
+                ctypes.cast(ctypes.c_char_p(seq),
+                            ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.cast(ctypes.c_char_p(qual),
+                            ctypes.POINTER(ctypes.c_uint8)), len(qual))
         if rc != 0:
             raise OSError("write failed")
 

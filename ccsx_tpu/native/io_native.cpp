@@ -872,6 +872,22 @@ int ccsx_writer_put_fasta(void* h, const char* name, const uint8_t* seq,
   return w->put(std::move(s)) ? 0 : -1;
 }
 
+// FASTQ record: @name / seq / + / qual (qual = phred+33 ASCII, len bytes)
+int ccsx_writer_put_fastq(void* h, const char* name, const uint8_t* seq,
+                          const uint8_t* qual, int64_t len) {
+  Writer* w = (Writer*)h;
+  std::string s;
+  s.reserve(2 * (size_t)len + std::strlen(name) + 6);
+  s.push_back('@');
+  s.append(name);
+  s.push_back('\n');
+  s.append((const char*)seq, (size_t)len);
+  s.append("\n+\n", 3);
+  s.append((const char*)qual, (size_t)len);
+  s.push_back('\n');
+  return w->put(std::move(s)) ? 0 : -1;
+}
+
 // returns 0 ok, -1 if any write failed
 int ccsx_writer_close(void* h) {
   Writer* w = (Writer*)h;

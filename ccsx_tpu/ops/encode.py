@@ -51,6 +51,21 @@ def decode(codes: np.ndarray) -> str:
     return _DEC[np.asarray(codes, dtype=np.uint8)].tobytes().decode()
 
 
+def to_record(result):
+    """Normalize a consensus-generator result into a writable record.
+
+    codes -> (seq_bytes, None); (codes, phred_quals) -> (seq_bytes,
+    phred+33 ASCII bytes); None -> None.  The quality tuple form is
+    produced under CcsConfig.emit_quality (--fastq)."""
+    if result is None:
+        return None
+    if isinstance(result, tuple):
+        codes, quals = result
+        qual = (np.asarray(quals, dtype=np.uint8) + 33).tobytes()
+        return decode(codes).encode(), qual
+    return decode(result).encode(), None
+
+
 def revcomp_ascii(seq: bytes) -> bytes:
     """Reverse-complement of an ASCII sequence (seq_reverse_comp, seqio.h:138-148)."""
     arr = np.frombuffer(seq, dtype=np.uint8)

@@ -27,6 +27,8 @@ def make_voter(max_ins: int = 4):
       ins_votes(T, R) int32 — passes inserting at least r+1 bases at the slot
       ncov     (T,) int32  — covering passes per column
       match    (P, T) bool — pass agrees with consensus at base column
+      nwin     (T,) int32  — passes voting the winning cell (per-base
+                             quality derives from the nwin/ncov margin)
     """
 
     @jax.jit
@@ -36,6 +38,7 @@ def make_voter(max_ins: int = 4):
             [((aligned == c) & mask).sum(0) for c in range(5)]
         )  # (5, T): A C G T gap
         ncov = cnts.sum(0)
+        nwin = cnts.max(0)
         cons = jnp.argmax(cnts, axis=0).astype(jnp.uint8)
         cons = jnp.where(ncov == 0, jnp.uint8(GAP), cons)
 
@@ -51,7 +54,7 @@ def make_voter(max_ins: int = 4):
         ins_votes = jnp.stack(votes, axis=1)
 
         match = (aligned == cons[None, :]) & mask
-        return cons, ins_base, ins_votes, ncov, match
+        return cons, ins_base, ins_votes, ncov, match, nwin
 
     return vote
 

@@ -80,11 +80,16 @@ class ShardWriter:
         self._f = open(self.path, mode)
         self._idx = open(self.path + ".idx", mode)
 
-    def put_at(self, local_idx: int, name: str, seq: bytes) -> None:
-        self._f.write(f">{name}\n{seq.decode()}\n")
+    def put_at(self, local_idx: int, name: str, seq: bytes,
+               qual: bytes | None = None) -> None:
+        if qual is None:
+            self._f.write(f">{name}\n{seq.decode()}\n")
+        else:
+            self._f.write(f"@{name}\n{seq.decode()}\n+\n{qual.decode()}\n")
         self._idx.write(f"{self.rank + local_idx * self.n}\n")
 
-    def put(self, name: str, seq: bytes) -> None:  # pragma: no cover
+    def put(self, name: str, seq: bytes,
+            qual: bytes | None = None) -> None:  # pragma: no cover
         raise RuntimeError("ShardWriter requires put_at")
 
     def close(self) -> None:
@@ -145,9 +150,11 @@ def merge_shards(out_path: str, n: int, cleanup: bool = True) -> int:
                 header = f.readline()
                 if not header:
                     return
-                seq = f.readline()
+                # FASTA record = 2 lines, FASTQ = 4 (seq, '+', qual)
+                lines = 1 if header[0] == ">" else 3
+                rec = header + "".join(f.readline() for _ in range(lines))
                 idx = int(fi.readline())
-                yield idx, header + seq
+                yield idx, rec
 
     count = 0
     with open(out_path, "w") as out:

@@ -64,9 +64,9 @@ def make_sharded_round(mesh: Mesh, params: AlignParams, tmax: int,
       row_mask (Z, Pp) bool
 
     Output: cons (Z, tmax) uint8, ins_base (Z, tmax, R) uint8,
-      ins_votes (Z, tmax, R) int32, ncov (Z, tmax) int32 —
-      all sharded over 'data' only (vote results are replicated over 'pass'
-      after the psum).
+      ins_votes (Z, tmax, R) int32, ncov (Z, tmax) int32,
+      nwin (Z, tmax) int32 — all sharded over 'data' only (vote results
+      are replicated over 'pass' after the psum).
     """
     projector = traceback.make_projector(tmax, max_ins)
 
@@ -89,6 +89,7 @@ def make_sharded_round(mesh: Mesh, params: AlignParams, tmax: int,
         )  # (Zl, 5, T)
         cnts = jax.lax.psum(cnts, "pass")
         ncov = cnts.sum(1)
+        nwin = cnts.max(1)
         cons = jnp.argmax(cnts, axis=1).astype(jnp.uint8)
         cons = jnp.where(ncov == 0, jnp.uint8(4), cons)
 
@@ -105,7 +106,7 @@ def make_sharded_round(mesh: Mesh, params: AlignParams, tmax: int,
             votes.append(votes_r)
         ins_base = jnp.stack(bases, axis=2)
         ins_votes = jnp.stack(votes, axis=2)
-        return cons, ins_base, ins_votes, ncov
+        return cons, ins_base, ins_votes, ncov, nwin
 
     shard = jax.shard_map(
         local_round,
@@ -113,7 +114,8 @@ def make_sharded_round(mesh: Mesh, params: AlignParams, tmax: int,
         in_specs=(P("data", "pass", None), P("data", "pass"),
                   P("data", None), P("data"), P("data", "pass")),
         out_specs=(P("data", None), P("data", None, None),
-                   P("data", None, None), P("data", None)),
+                   P("data", None, None), P("data", None),
+                   P("data", None)),
         # the DP scan carry mixes replicated init constants with varying
         # values; skip the vma consistency check rather than pcast every
         # carry component

@@ -78,10 +78,10 @@ def _round_body(params: AlignParams, max_ins: int, tmax: int):
                         in_axes=(0, 0, 0, 0, 0))
         aligned, ins_cnt, ins_b, lead_ins = proj(
             moves, offs, qs, qlens, dlen)
-        cons, ins_base, ins_votes, ncov, match = jax.vmap(voter)(
+        cons, ins_base, ins_votes, ncov, match, nwin = jax.vmap(voter)(
             aligned, ins_cnt, ins_b, row_mask)
-        return (cons, ins_base, ins_votes, ncov, match, aligned, ins_cnt,
-                lead_ins)
+        return (cons, ins_base, ins_votes, ncov, nwin, match, aligned,
+                ins_cnt, lead_ins)
 
     return body
 
@@ -104,7 +104,7 @@ def _round_step(params: AlignParams, max_ins: int, tmax: int,
 
     @jax.jit
     def step(qs, qlens, ts, tlens, row_mask):
-        (cons, ins_base, ins_votes, ncov, match, aligned, ins_cnt,
+        (cons, ins_base, ins_votes, ncov, nwin, match, aligned, ins_cnt,
          lead_ins) = body(qs, qlens, row_mask, ts, tlens)
         bp, advance = jax.vmap(bp_advance)(
             match, cons, aligned, ins_cnt, lead_ins, row_mask, tlens)
@@ -113,7 +113,8 @@ def _round_step(params: AlignParams, max_ins: int, tmax: int,
         # transfer; the host casts back before arithmetic
         # (msa.emit_insertions)
         return (cons, ins_base, ins_votes.astype(jax.numpy.uint8),
-                ncov.astype(jax.numpy.uint8), bp, advance)
+                ncov.astype(jax.numpy.uint8),
+                nwin.astype(jax.numpy.uint8), bp, advance)
 
     return step
 
@@ -170,7 +171,7 @@ def _refine_step(params: AlignParams, max_ins: int, tmax: int, iters: int,
         def body(carry):
             it, draft, dlen, fixed, ovf = carry
             cons, ins_base, ins_votes, ncov, *_ = one_round(
-                qs, qlens, row_mask, draft, dlen)
+                qs, qlens, row_mask, draft, dlen)  # nwin+ unused here
             ins_out = spec_emit(ins_base, ins_votes, ncov)
             nd, nl, o = mat_v(cons, ins_out, dlen)
             # fixpoint: same length AND same padded cells == the host's
@@ -198,13 +199,14 @@ def _refine_step(params: AlignParams, max_ins: int, tmax: int, iters: int,
         ovf0 = jnp.zeros(fixed0.shape, bool)
         _, draft, dlen, _, ovf = jax.lax.while_loop(
             cond, body, (jnp.int32(0), ts, tlens, fixed0, ovf0))
-        (cons, ins_base, ins_votes, ncov, match, aligned, ins_cnt,
+        (cons, ins_base, ins_votes, ncov, nwin, match, aligned, ins_cnt,
          lead_ins) = one_round(qs, qlens, row_mask, draft, dlen)
         bp, advance = jax.vmap(bp_advance)(
             match, cons, aligned, ins_cnt, lead_ins, row_mask, dlen)
         # uint8 vote/coverage compaction, as in _round_step
         return (cons, ins_base, ins_votes.astype(jnp.uint8),
-                ncov.astype(jnp.uint8), bp, advance, dlen, ovf)
+                ncov.astype(jnp.uint8), nwin.astype(jnp.uint8),
+                bp, advance, dlen, ovf)
 
     return step
 
@@ -458,12 +460,12 @@ class BatchExecutor:
                                self._bp_consts())
             pending.append((idxs, step(*self._shard_args(args, P))))
         for idxs, out in pending:
-            (cons, ins_base, ins_votes, ncov, bp, advance) = (
+            (cons, ins_base, ins_votes, ncov, nwin, bp, advance) = (
                 np.asarray(o) for o in out)
             for z, i in enumerate(idxs):
                 results[i] = RoundResult(
                     cons=cons[z], ins_base=ins_base[z],
-                    ins_votes=ins_votes[z], ncov=ncov[z],
+                    ins_votes=ins_votes[z], ncov=ncov[z], nwin=nwin[z],
                     tlen=len(requests[i].draft),
                     bp=int(bp[z]), advance=advance[z],
                 )
@@ -494,8 +496,8 @@ class BatchExecutor:
                                 iters, self._bp_consts())
             pending.append((idxs, step(*self._shard_args(args, P))))
         for idxs, out in pending:
-            (cons, ins_base, ins_votes, ncov, bp, advance, dlen, ovf) = (
-                np.asarray(o) for o in out)
+            (cons, ins_base, ins_votes, ncov, nwin, bp, advance, dlen,
+             ovf) = (np.asarray(o) for o in out)
             for z, i in enumerate(idxs):
                 req = requests[i]
                 if ovf[z]:
@@ -507,7 +509,7 @@ class BatchExecutor:
                     continue
                 rr = RoundResult(
                     cons=cons[z], ins_base=ins_base[z],
-                    ins_votes=ins_votes[z], ncov=ncov[z],
+                    ins_votes=ins_votes[z], ncov=ncov[z], nwin=nwin[z],
                     tlen=int(dlen[z]), bp=int(bp[z]), advance=advance[z],
                 )
                 results[i] = RefineResult(rr=rr)
@@ -522,7 +524,7 @@ class _Hole:
     req: object = None         # pending PairRequest | RefineRequest
     done: bool = False
     resumed: bool = False      # written by a previous run; skip + no journal
-    cns: Optional[bytes] = None
+    cns: Optional[tuple] = None  # (seq_bytes, qual_bytes|None)
     err: Optional[Exception] = None
 
 
@@ -549,8 +551,9 @@ def _advance_hole(hole: _Hole, rr) -> None:
         hole.done, hole.req, hole.err = True, None, e
 
 
-def _finish(codes: np.ndarray) -> Optional[bytes]:
-    return enc.decode(codes).encode() if codes is not None else None
+def _finish(result):
+    """Generator result -> (seq_bytes, qual|None) or None (skipped)."""
+    return enc.to_record(result)
 
 
 def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
@@ -559,9 +562,9 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
 
     Shared by the single-process driver (run_pipeline_batched) and the
     multi-host sharded driver (parallel/distributed.py).  If the writer
-    exposes ``put_at(idx, name, seq)`` it receives each record's hole
-    ordinal too (the distributed shard writer needs it to restore global
-    order at merge time).
+    exposes ``put_at(idx, name, seq, qual)`` it receives each record's
+    hole ordinal too (the distributed shard writer needs it to restore
+    global order at merge time).
     """
     from ccsx_tpu.io import bam as bam_mod
     from ccsx_tpu.io import zmw as zmw_mod
@@ -593,13 +596,14 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
                 metrics.holes_failed += 1
                 print(f"[ccsx-tpu] hole {h.zmw.movie}/{h.zmw.hole} "
                       f"failed: {h.err}", file=sys.stderr)
-            elif h.cns:
+            elif h.cns is not None and h.cns[0]:
                 name = f"{h.zmw.movie}/{h.zmw.hole}/ccs"
+                seq, qual = h.cns
                 with metrics.timer("write"):
                     if put_at is not None:
-                        put_at(h.idx, name, h.cns)
+                        put_at(h.idx, name, seq, qual)
                     else:
-                        writer.put(name, h.cns)
+                        writer.put(name, seq, qual)
                 metrics.holes_out += 1
             journal.advance()
             metrics.tick()

@@ -46,14 +46,17 @@ def open_zmw_stream(path: str, cfg: CcsConfig):
 
 
 class _PyWriter:
-    """FASTA writer over a Python file object (stdout / fallback path)."""
+    """FASTA/FASTQ writer over a Python file object (stdout / fallback)."""
 
     def __init__(self, f, own: bool):
         self._f = f
         self._own = own
 
-    def put(self, name: str, seq: bytes) -> None:
-        self._f.write(f">{name}\n{seq.decode()}\n")
+    def put(self, name: str, seq: bytes, qual: bytes | None = None) -> None:
+        if qual is None:
+            self._f.write(f">{name}\n{seq.decode()}\n")
+        else:
+            self._f.write(f"@{name}\n{seq.decode()}\n+\n{qual.decode()}\n")
 
     def close(self) -> None:
         if self._own:
@@ -103,7 +106,7 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
             return z, None, e, stats
 
     def write_result(item):
-        z, cns, err, stats = item
+        z, rec, err, stats = item
         # per-hole counters aggregated here (driver side) so worker
         # threads never touch the Metrics object concurrently.
         # device_dispatches counts jitted device invocations: each
@@ -116,8 +119,8 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
                 metrics.holes_failed += 1
                 print(f"[ccsx-tpu] hole {z.movie}/{z.hole} failed: {err}",
                       file=sys.stderr)
-            elif cns:
-                writer.put(f"{z.movie}/{z.hole}/ccs", cns)
+            elif rec is not None and rec[0]:
+                writer.put(f"{z.movie}/{z.hole}/ccs", rec[0], rec[1])
                 metrics.holes_out += 1
         journal.advance()
         metrics.tick()

@@ -122,7 +122,7 @@ def test_whole_read_consensus_identity(n_passes, min_identity, rng):
                        sub_rate=0.02, ins_rate=0.04, del_rate=0.04)
     zz = _zmw_from_synth(z)
     aligner = HostAligner(CFG.align)
-    cns = whole_read.ccs_whole_read(zz, aligner, CFG)
+    cns, _ = whole_read.ccs_whole_read(zz, aligner, CFG)
     assert cns is not None
     idy = synth.identity(enc.encode(cns), z.template)
     assert idy >= min_identity, f"consensus identity {idy:.4f}"

@@ -31,7 +31,7 @@ def test_windowed_matches_template_long_read(rng):
     z = synth.make_zmw(rng, template_len=3000, n_passes=6,
                        sub_rate=0.02, ins_rate=0.04, del_rate=0.04)
     zz = _zmw_from_synth(z)
-    cns = windowed.ccs_windowed(zz, HostAligner(cfg.align), cfg)
+    cns, _ = windowed.ccs_windowed(zz, HostAligner(cfg.align), cfg)
     assert cns is not None
     idy = synth.identity_either(enc.encode(cns), z.template)
     assert idy > 0.985, f"windowed identity {idy:.4f}"
@@ -43,7 +43,7 @@ def test_windowed_short_molecule_single_flush(rng):
     cfg = CcsConfig(is_bam=False)
     z = synth.make_zmw(rng, template_len=700, n_passes=5)
     zz = _zmw_from_synth(z)
-    cns = windowed.ccs_windowed(zz, HostAligner(cfg.align), cfg)
+    cns, _ = windowed.ccs_windowed(zz, HostAligner(cfg.align), cfg)
     idy = synth.identity_either(enc.encode(cns), z.template)
     assert idy > 0.97
 
@@ -209,7 +209,7 @@ def test_windowed_partial_end_passes(rng):
     assert len(passes) == 5
     assert calls == []
 
-    cns = windowed.ccs_windowed(zz, HostAligner(cfg.align), cfg)
+    cns, _ = windowed.ccs_windowed(zz, HostAligner(cfg.align), cfg)
     idy = synth.identity_either(enc.encode(cns), z.template)
     assert idy > 0.97
 

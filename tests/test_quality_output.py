@@ -1,0 +1,103 @@
+"""Per-base quality output (--fastq): vote-margin Phred qualities.
+
+An extension over the reference, which writes FASTA only (main.c:714) —
+so there is no reference behavior to match; these tests pin internal
+consistency instead: FASTQ well-formedness, seq==FASTA-seq invariance,
+batched==per-hole byte parity, and the quality semantics (higher pass
+count / unanimity => higher Q; disagreement lowers Q).
+"""
+
+import numpy as np
+import pytest
+
+from ccsx_tpu import cli
+from ccsx_tpu.config import CcsConfig
+from ccsx_tpu.consensus.star import StarMsa
+from ccsx_tpu.io import fastx
+from ccsx_tpu.utils import synth
+
+
+def _write_fasta(tmp_path, rng, n_holes=3, tlen=700, n_passes=5):
+    zs = [synth.make_zmw(rng, template_len=tlen, n_passes=n_passes + (h % 3),
+                         movie="mv", hole=str(h)) for h in range(n_holes)]
+    fa = tmp_path / "in.fa"
+    fa.write_text(synth.make_fasta(zs))
+    return zs, fa
+
+
+def test_fastq_well_formed_and_seq_matches_fasta(tmp_path, rng):
+    """--fastq output: 4-line records, qual length == seq length, and the
+    sequences byte-equal the FASTA run's."""
+    zs, fa = _write_fasta(tmp_path, rng)
+    ofa, ofq = tmp_path / "o.fa", tmp_path / "o.fq"
+    assert cli.main(["-A", "-m", "1000", str(fa), str(ofa)]) == 0
+    assert cli.main(["-A", "-m", "1000", "--fastq", str(fa), str(ofq)]) == 0
+    fq = list(fastx.read_fastx(str(ofq)))
+    fa_recs = list(fastx.read_fastx(str(ofa)))
+    assert len(fq) == len(fa_recs) == len(zs)
+    for a, q in zip(fa_recs, fq):
+        assert a.name == q.name
+        assert a.seq == q.seq
+        assert q.qual is not None and len(q.qual) == len(q.seq)
+        # phred+33, within the configured cap
+        arr = np.frombuffer(q.qual, np.uint8) - 33
+        assert arr.min() >= 1 and arr.max() <= CcsConfig.qv_cap
+
+
+@pytest.mark.parametrize("batch", ["on", "off"])
+def test_fastq_batched_equals_per_hole(tmp_path, rng, batch):
+    """--fastq byte parity between the fused batched path and the
+    per-hole path (qualities derive from transferred nwin/votes)."""
+    zs, fa = _write_fasta(tmp_path, rng, n_holes=3)
+    o1, o2 = tmp_path / "a.fq", tmp_path / "b.fq"
+    assert cli.main(["-A", "-m", "1000", "--fastq", "--batch", "off",
+                     str(fa), str(o1)]) == 0
+    assert cli.main(["-A", "-m", "1000", "--fastq", "--batch", batch,
+                     str(fa), str(o2)]) == 0
+    assert o1.read_text() == o2.read_text()
+
+
+def test_fastq_whole_read_mode(tmp_path, rng):
+    zs, fa = _write_fasta(tmp_path, rng, n_holes=2)
+    out = tmp_path / "o.fq"
+    assert cli.main(["-A", "-P", "-m", "1000", "--fastq",
+                     str(fa), str(out)]) == 0
+    recs = list(fastx.read_fastx(str(out)))
+    assert len(recs) == 2
+    for r in recs:
+        assert len(r.qual) == len(r.seq)
+
+
+def test_quality_rises_with_pass_count(rng):
+    """Mean vote-margin Q must increase with coverage (the whole point)."""
+    from ccsx_tpu.consensus import whole_read
+
+    tpl = rng.integers(0, 4, 600).astype(np.uint8)
+    means = []
+    for n in (4, 8, 16):
+        cfg = CcsConfig(is_bam=False, emit_quality=True)
+        ps = [synth.mutate(rng, tpl, 0.02, 0.04, 0.04) for _ in range(n)]
+        codes, quals = whole_read.consensus_passes(ps, cfg)
+        assert len(quals) == len(codes)
+        means.append(float(np.mean(quals)))
+    assert means[0] < means[1] < means[2], means
+
+
+def test_quality_drops_at_disputed_columns(rng):
+    """A column where passes split must score lower than unanimous ones."""
+    cfg = CcsConfig(is_bam=False)
+    sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
+    tpl = rng.integers(0, 4, 400).astype(np.uint8)
+    ps = [tpl.copy() for _ in range(8)]
+    # half the passes disagree at one column
+    disputed = 200
+    for p in ps[:4]:
+        p[disputed] = (p[disputed] + 1) % 4
+    qs, qlens, row_mask = sm.pack(ps, cfg.pass_buckets, cfg.max_passes)
+    rr = sm.round(qs, qlens, row_mask, tpl)
+    codes, quals = rr.materialize_with_qual()
+    np.testing.assert_array_equal(codes, tpl)  # 4-4 tie keeps a base
+    assert quals[disputed] < quals[disputed - 1]
+    assert quals[disputed] <= 2  # net margin ~0 -> floor
+    # unanimous columns sit at the cap for 8 passes: 2.5 * 8 = 20
+    assert quals[disputed - 1] == 20

@@ -2,7 +2,7 @@
 
 The column vote is a pure reduction over the pass axis (reference: the MSA
 column scan at main.c:583-598 counts rows per column), so sharding passes
-across devices and psum-ing the counts must change NOTHING: all four
+across devices and psum-ing the counts must change NOTHING: all five
 outputs of parallel/mesh.make_sharded_round must equal the per-hole
 StarMsa.round outputs exactly — same argmax tie-breaks, same counts.
 A subtly wrong collective (wrong axis, double-count, dropped remainder)
@@ -61,6 +61,7 @@ def _unsharded_reference(qs, qlens, ts, tlens, row_mask):
     ins_base = np.zeros((Z, W, MAX_INS), np.uint8)
     ins_votes = np.zeros((Z, W, MAX_INS), np.int32)
     ncov = np.zeros((Z, W), np.int32)
+    nwin = np.zeros((Z, W), np.int32)
     for z in range(Z):
         rr = sm.round(qs[z], qlens[z], row_mask[z],
                       ts[z, : int(tlens[z])])
@@ -69,7 +70,8 @@ def _unsharded_reference(qs, qlens, ts, tlens, row_mask):
         ins_base[z, :T] = rr.ins_base
         ins_votes[z, :T] = rr.ins_votes
         ncov[z, :T] = rr.ncov
-    return cons, ins_base, ins_votes, ncov
+        nwin[z, :T] = rr.nwin
+    return cons, ins_base, ins_votes, ncov, nwin
 
 
 def _run_sharded(shape, qs, qlens, ts, tlens, row_mask):
@@ -81,12 +83,12 @@ def _run_sharded(shape, qs, qlens, ts, tlens, row_mask):
 
 
 def test_pass_sharded_equals_unsharded_exact(rng):
-    """(4,2) data x pass mesh == per-hole rounds, all four outputs exact."""
+    """(4,2) data x pass mesh == per-hole rounds, all outputs exact."""
     qs, qlens, ts, tlens, row_mask = _batch(rng, Z=8, P=8)
     got = _run_sharded((4, 2), qs, qlens, ts, tlens, row_mask)
     want = _unsharded_reference(qs, qlens, ts, tlens, row_mask)
     for g, w, name in zip(got, want, ("cons", "ins_base", "ins_votes",
-                                      "ncov")):
+                                      "ncov", "nwin")):
         # beyond each hole's tlen both paths carry frozen padding whose
         # value is tie-broken identically (verified by the exact compare
         # over the full tmax here — no masking applied)
@@ -100,7 +102,7 @@ def test_pass_axis_split_invariant(rng):
             for s in ((8, 1), (4, 2), (2, 4))]
     for other, shape in zip(outs[1:], ("(4,2)", "(2,4)")):
         for g, w, name in zip(other, outs[0],
-                              ("cons", "ins_base", "ins_votes", "ncov")):
+                              ("cons", "ins_base", "ins_votes", "ncov", "nwin")):
             np.testing.assert_array_equal(
                 g, w, err_msg=f"{name} differs between (8,1) and {shape}")
 
@@ -117,6 +119,6 @@ def test_sharded_round_dead_rows_on_one_device(rng):
     got = _run_sharded((4, 2), qs, qlens, ts, tlens, row_mask)
     want = _unsharded_reference(qs, qlens, ts, tlens, row_mask)
     for g, w, name in zip(got, want, ("cons", "ins_base", "ins_votes",
-                                      "ncov")):
+                                      "ncov", "nwin")):
         np.testing.assert_array_equal(g, w, err_msg=name)
     assert int(got[3].max()) <= 4
