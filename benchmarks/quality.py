@@ -233,6 +233,80 @@ def sweep_max_passes(rng, n_holes=3, tlen=1200, deep=48):
     return out
 
 
+def per_base_errors(cns: np.ndarray, tpl: np.ndarray) -> np.ndarray:
+    """Per-consensus-base error flags from a global alignment vs the
+    template (better orientation): substitution at an 'M' column with
+    differing bases, or an 'I' (consensus-only) base.  Deletions have no
+    consensus base to blame and are excluded (counted by the caller via
+    the cigar if needed)."""
+    from ccsx_tpu.ops import oracle
+
+    rc = enc.revcomp_codes(cns)
+    r_f = oracle.align(cns, tpl, mode="global")
+    r_r = oracle.align(rc, tpl, mode="global")
+    fwd = r_f.identity >= r_r.identity
+    r, q = (r_f, cns) if fwd else (r_r, rc)
+    err = np.zeros(len(q), bool)
+    i, j = r.qb, r.tb
+    for op, n in r.cigar:
+        if op == "M":
+            err[i:i + n] = q[i:i + n] != tpl[j:j + n]
+            i += n
+            j += n
+        elif op == "I":
+            err[i:i + n] = True
+            i += n
+        else:  # D
+            j += n
+    return err if fwd else err[::-1]
+
+
+def quality_calibration(rng, n_holes=16, tlen=800):
+    """Empirical check of the --fastq vote-margin qualities: bin emitted
+    bases by predicted Q, measure the observed per-base error rate per
+    bin.  The mapping is usable if observed error falls monotonically
+    with predicted Q (it is documented as a confidence score, not a
+    calibrated QV — this quantifies how conservative/liberal it is)."""
+    cfg = CcsConfig(is_bam=False, min_subread_len=1000, emit_quality=True)
+    edges = [0, 5, 10, 15, 20, 30, 61]
+    errs = np.zeros(len(edges) - 1, np.int64)
+    tot = np.zeros(len(edges) - 1, np.int64)
+    for h in range(n_holes):
+        npass = int(sample_pass_counts(rng, 1)[0])
+        z = synth.make_zmw(rng, tlen, npass, movie="mv", hole=str(h), **ERR)
+        lens = np.array([len(p) for p in z.passes], np.int32)
+        offs = np.zeros(len(lens), np.int32)
+        if len(lens) > 1:
+            np.cumsum(lens[:-1], out=offs[1:])
+        from ccsx_tpu.io.zmw import Zmw
+
+        zz = Zmw(movie=z.movie, hole=z.hole,
+                 seqs=enc.decode(np.concatenate(z.passes)).encode(),
+                 lens=lens, offs=offs)
+        passes = prep.oriented_passes(zz, HostAligner(cfg.align), cfg)
+        if passes is None:
+            continue
+        cns, quals = consensus_windowed(passes, cfg)
+        err = per_base_errors(cns, z.template)
+        which = np.digitize(quals, edges) - 1
+        for b in range(len(edges) - 1):
+            sel = which == b
+            errs[b] += int(err[sel].sum())
+            tot[b] += int(sel.sum())
+    bins = []
+    for b in range(len(edges) - 1):
+        if tot[b] == 0:
+            continue
+        rate = errs[b] / tot[b]
+        bins.append({
+            "predicted_q": f"[{edges[b]},{edges[b + 1]})",
+            "bases": int(tot[b]),
+            "observed_error_rate": round(float(rate), 5),
+            "observed_q": round(-10 * math.log10(max(rate, 1e-6)), 1),
+        })
+    return bins
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--holes", type=int, default=12)
@@ -257,6 +331,8 @@ def main():
         rng, n_holes=8 if a.full else 4)
     res["sweep_max_passes"] = sweep_max_passes(
         rng, n_holes=6 if a.full else 3)
+    res["quality_calibration"] = quality_calibration(
+        rng, n_holes=32 if a.full else 16)
     print(json.dumps(res, indent=1))
     if a.json:
         with open(a.json, "w") as f:

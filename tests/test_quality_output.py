@@ -83,6 +83,31 @@ def test_quality_rises_with_pass_count(rng):
     assert means[0] < means[1] < means[2], means
 
 
+def test_quality_calibration_monotone(rng):
+    """Observed per-base error must fall as predicted Q rises (coarse
+    3-bin check of the benchmarks/quality.py calibration on a small
+    sample; the full sweep is recorded in quality_r03.json)."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks"))
+    import quality as qmod
+
+    bins = qmod.quality_calibration(rng, n_holes=8, tlen=500)
+    rates = {}
+    for b in bins:
+        lo = int(b["predicted_q"].split(",")[0][1:])
+        coarse = 0 if lo < 10 else (1 if lo < 20 else 2)
+        e, n = rates.get(coarse, (0, 0))
+        rates[coarse] = (e + b["observed_error_rate"] * b["bases"],
+                         n + b["bases"])
+    assert set(rates) == {0, 1, 2}
+    r = [rates[k][0] / rates[k][1] for k in (0, 1, 2)]
+    assert r[0] > r[1] > r[2], r
+
+
 def test_quality_drops_at_disputed_columns(rng):
     """A column where passes split must score lower than unanimous ones."""
     cfg = CcsConfig(is_bam=False)
