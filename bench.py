@@ -149,9 +149,11 @@ def main():
     # flips the axon dev tunnel into ~80ms-RTT sync dispatch (see
     # ARCHITECTURE.md perf notes) — ordering keeps the round metric
     # honest; on direct (non-tunnel) TPU hardware there is no such mode.
-    # CCSX_BENCH_E2E=0 skips; CCSX_BENCH_E2E_HOLES resizes (default 8).
+    # CCSX_BENCH_E2E=0 skips; CCSX_BENCH_E2E_HOLES resizes (default 16 —
+    # the fused window refinement makes dispatch count ~independent of
+    # the hole count, so more holes amortize the per-dispatch cost).
     if os.environ.get("CCSX_BENCH_E2E", "1") != "0":
-        holes = int(os.environ.get("CCSX_BENCH_E2E_HOLES", "8"))
+        holes = int(os.environ.get("CCSX_BENCH_E2E_HOLES", "16"))
         # soft deadline: cold compiles through a remote-compile tunnel
         # can take minutes per config; losing the whole JSON line to a
         # driver timeout is worse than skipping tail configs
