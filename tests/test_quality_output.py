@@ -57,6 +57,44 @@ def test_fastq_batched_equals_per_hole(tmp_path, rng, batch):
     assert o1.read_text() == o2.read_text()
 
 
+def test_fastq_multiwindow_stitching_batched_parity(tmp_path, rng):
+    """A >1-window molecule: per-window qual slices (materialize upto
+    the breakpoint) must stitch to the same FASTQ in the per-hole and
+    fused batched paths."""
+    zs = [synth.make_zmw(rng, template_len=2600, n_passes=6,
+                         movie="mv", hole=str(h)) for h in range(2)]
+    fa = tmp_path / "in.fa"
+    fa.write_text(synth.make_fasta(zs))
+    o1, o2 = tmp_path / "a.fq", tmp_path / "b.fq"
+    win = ["--refine-iters", "2"]
+    base = ["-A", "-m", "1000", "--fastq"] + win
+    assert cli.main(base + ["--batch", "off", str(fa), str(o1)]) == 0
+    assert cli.main(base + ["--batch", "on", str(fa), str(o2)]) == 0
+    assert o1.read_text() == o2.read_text()
+    for r in fastx.read_fastx(str(o1)):
+        assert len(r.qual) == len(r.seq) > 2000
+
+
+def test_fastq_journal_resume(tmp_path, rng):
+    """Resuming a --fastq run appends well-formed FASTQ records."""
+    import json
+
+    zs, fa = _write_fasta(tmp_path, rng, n_holes=3)
+    full = tmp_path / "full.fq"
+    assert cli.main(["-A", "-m", "1000", "--fastq", "--batch", "on",
+                     str(fa), str(full)]) == 0
+    out = tmp_path / "o.fq"
+    jp = tmp_path / "j.json"
+    jp.write_text(json.dumps({"input_id": str(fa), "holes_done": 2}))
+    recs = list(fastx.read_fastx(str(full)))
+    out.write_text("".join(
+        f"@{r.name}\n{r.seq.decode()}\n+\n{r.qual.decode()}\n"
+        for r in recs[:2]))
+    assert cli.main(["-A", "-m", "1000", "--fastq", "--batch", "on",
+                     "--journal", str(jp), str(fa), str(out)]) == 0
+    assert out.read_text() == full.read_text()
+
+
 def test_fastq_whole_read_mode(tmp_path, rng):
     zs, fa = _write_fasta(tmp_path, rng, n_holes=2)
     out = tmp_path / "o.fq"
