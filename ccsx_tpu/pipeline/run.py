@@ -109,9 +109,10 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
         z, rec, err, stats = item
         # per-hole counters aggregated here (driver side) so worker
         # threads never touch the Metrics object concurrently.
-        # device_dispatches counts jitted device invocations: each
-        # per-hole round makes 3 (aligner, projector, voter — the
-        # batched executor fuses them into one jitted step per group)
+        # device_dispatches is a lower-bound estimate on this path: each
+        # window runs >=1 refinement round of 3 jitted calls (aligner,
+        # projector, voter); the batched executor's count is exact (one
+        # fused dispatch per shape group)
         metrics.windows += stats.get("windows", 0)
         metrics.device_dispatches += 3 * stats.get("windows", 0)
         with metrics.timer("write"):
