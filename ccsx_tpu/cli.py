@@ -91,6 +91,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Write FASTQ with per-base vote-margin qualities "
                         "instead of FASTA (extension; the reference "
                         "emits FASTA only)")
+    p.add_argument("--bam", action="store_true", dest="bam_out",
+                   help="Write unaligned BAM (qual fields + rq aux tag; "
+                        "implies --fastq's quality computation)")
     p.add_argument("--window-growth", default="flush",
                    choices=["flush", "grow"],
                    help="When no breakpoint is found at max-window: "
@@ -153,7 +156,8 @@ def config_from_args(args) -> CcsConfig:
         verbose=args.verbose,
         refine_iters=args.refine_iters,
         max_passes=args.max_passes,
-        emit_quality=args.fastq,
+        emit_quality=args.fastq or args.bam_out,
+        bam_out=args.bam_out,
         window_growth=args.window_growth,
         mesh_shape=mesh_shape,
         device=args.device,
@@ -180,10 +184,27 @@ def main(argv: Optional[list] = None) -> int:
               "shards", file=sys.stderr)
         return 0
 
+    if args.bam_out and args.fastq:
+        print("Error: --fastq and --bam are mutually exclusive",
+              file=sys.stderr)
+        return 1
+    if args.bam_out and args.journal is not None:
+        # the BGZF container is written whole at close, so a journal
+        # could never be resumed — reject the trap up front
+        print("Error: --bam does not support --journal (the BAM "
+              "container cannot be appended on resume)", file=sys.stderr)
+        return 1
     sharded = args.hosts is not None and args.hosts > 1
     if sharded:
         if args.host_id is None:
             print("Error: --hosts requires --host-id", file=sys.stderr)
+            return 1
+        if args.bam_out:
+            # shard files are text FASTA/FASTQ merged by merge_shards;
+            # write FASTQ shards and convert after the merge instead
+            print("Error: --bam is not supported with --hosts "
+                  "(use --fastq and convert the merged output)",
+                  file=sys.stderr)
             return 1
         if args.batch == "off":
             # the sharded driver is built on the batched scheduler (its

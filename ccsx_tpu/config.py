@@ -74,6 +74,8 @@ class CcsConfig:
     #      only, main.c:714 — no qualities exist to compare against) ----
     emit_quality: bool = False         # CLI --fastq: write FASTQ with
     #   vote-margin Phred qualities (star.RoundResult.materialize_with_qual)
+    bam_out: bool = False              # CLI --bam: unaligned BAM output with
+    #   qual fields filled (implies emit_quality) + an rq aux tag
     qv_per_net_vote: float = 2.5       # Phred per net agreeing vote, fitted
     #   to the measured pass-count->identity profile (BASELINE.md)
     qv_cap: int = 60                   # quality ceiling (vote margins with

@@ -260,6 +260,46 @@ def write_bgzf(path, data: bytes) -> None:
         fh.write(BGZF_EOF)
 
 
+class BamWriter:
+    """Ordered unaligned-BAM output writer (CLI --bam).
+
+    Buffers records and writes the BGZF container at close() — CCS
+    output is orders of magnitude smaller than the subread input, so
+    buffering is fine at real run sizes, and it keeps the writer a thin
+    shim over write_bam.  Each record carries the consensus sequence,
+    the vote-margin qualities (phred+33 in, raw phred in BAM), and an
+    ``rq`` float aux tag (predicted read accuracy = 1 - mean per-base
+    error), the tag HiFi consumers expect.  The reference has no BAM
+    output (FASTA only, main.c:714)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        # fail fast on an unwritable path (the container itself is
+        # written at close, after hours of compute on real inputs)
+        open(path, "wb").close()
+        self._records = []
+        self._closed = False
+
+    def put(self, name: str, seq: bytes, qual: bytes | None = None) -> None:
+        if self._closed:
+            raise ValueError("writer is closed")
+        aux = ()
+        if qual is not None:
+            import numpy as np
+
+            q = np.frombuffer(qual, np.uint8).astype(np.float64) - 33
+            rq = 1.0 - float(np.mean(10.0 ** (-q / 10.0))) if len(q) else 0.0
+            aux = (("rq", "f", rq),)
+        self._records.append((name, seq, qual, aux))
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        write_bam(self.path, self._records)
+        self._records = []
+
+
 def write_bam(path, records, refs=(), bgzf: bool = True) -> None:
     """Tiny BAM writer for tests/fixtures (unmapped records only).
 

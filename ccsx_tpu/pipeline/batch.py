@@ -677,8 +677,8 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
     finally:
         try:
             writer.close()
-        except OSError:
-            print("Error: write failed!", file=sys.stderr)
+        except OSError as e:
+            print(f"Error: write failed! ({e})", file=sys.stderr)
             rc = 1
         metrics.report()
     return rc
@@ -721,9 +721,10 @@ def run_pipeline_batched(in_path: str, out_path: str, cfg: CcsConfig,
 
     journal = Journal.load_or_create(journal_path, input_id=in_path)
     try:
-        writer = open_writer(out_path, append=bool(journal.holes_done))
-    except OSError:
-        print("Cannot open file for write!", file=sys.stderr)
+        writer = open_writer(out_path, append=bool(journal.holes_done),
+                             bam=cfg.bam_out)
+    except OSError as e:
+        print(f"Cannot open file for write! ({e})", file=sys.stderr)
         return 1
     metrics = Metrics(verbose=cfg.verbose, stream=cfg.metrics_stream())
     return drive_batched(stream, writer, cfg, journal, metrics,

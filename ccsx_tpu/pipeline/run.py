@@ -63,13 +63,21 @@ class _PyWriter:
             self._f.close()
 
 
-def open_writer(path: str, append: bool):
-    """Async native writer for real paths; Python writer for stdout.
+def open_writer(path: str, append: bool, bam: bool = False):
+    """Async native writer for real paths; Python writer for stdout;
+    buffered BAM writer under --bam.
 
     stdout stays Python-level so redirection (tests, `ccsx-tpu ... -`) works.
     """
     from ccsx_tpu import native
 
+    if bam:
+        if path == "-":
+            raise OSError("--bam output requires a file path, not stdout")
+        if append:
+            raise OSError("--bam output does not support --journal resume "
+                          "(the BGZF container cannot be appended)")
+        return bam_mod.BamWriter(path)
     if path != "-" and native.available():
         from ccsx_tpu.native.io import NativeFastaWriter
 
@@ -89,9 +97,10 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
     journal = Journal.load_or_create(journal_path, input_id=in_path)
     resume = journal.holes_done
     try:
-        writer = open_writer(out_path, append=bool(resume))
-    except OSError:
-        print("Cannot open file for write!", file=sys.stderr)
+        writer = open_writer(out_path, append=bool(resume),
+                             bam=cfg.bam_out)
+    except OSError as e:
+        print(f"Cannot open file for write! ({e})", file=sys.stderr)
         return 1
 
     resolve_device(cfg.device)
@@ -166,8 +175,8 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
             pool.shutdown(wait=True)
         try:
             writer.close()
-        except OSError:
-            print("Error: write failed!", file=sys.stderr)
+        except OSError as e:
+            print(f"Error: write failed! ({e})", file=sys.stderr)
             rc = 1
         metrics.report()
     return rc

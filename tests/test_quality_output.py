@@ -106,6 +106,45 @@ def test_fastq_whole_read_mode(tmp_path, rng):
         assert len(r.qual) == len(r.seq)
 
 
+def test_bam_output_roundtrip(tmp_path, rng):
+    """--bam: unaligned BAM whose seq/qual round-trip through the BAM
+    reader equal the --fastq run's records, plus a sane rq aux tag."""
+    from ccsx_tpu.io import bam as bam_mod
+
+    zs, fa = _write_fasta(tmp_path, rng)
+    ofq, obam = tmp_path / "o.fq", tmp_path / "o.bam"
+    assert cli.main(["-A", "-m", "1000", "--fastq", str(fa), str(ofq)]) == 0
+    assert cli.main(["-A", "-m", "1000", "--bam", str(fa), str(obam)]) == 0
+    fq = {r.name: r for r in fastx.read_fastx(str(ofq))}
+    n = 0
+    for rec, aux in bam_mod.read_bam_records(str(obam), with_aux=True):
+        want = fq[rec.name]
+        assert rec.seq == want.seq
+        assert rec.qual == want.qual  # phred+33, identical to FASTQ
+        rq = bam_mod.aux2f(aux, "rq")
+        # predicted accuracy from the (conservative) vote-margin quals
+        assert 0.8 < rq < 1.0
+        n += 1
+    assert n == len(fq) == len(zs)
+
+
+def test_bam_output_flag_guards(tmp_path, rng, capsys):
+    """--bam rejects --journal (unresumable container), --fastq
+    (conflicting formats), and an unwritable path — all up front,
+    before any compute."""
+    zs, fa = _write_fasta(tmp_path, rng, n_holes=2)
+    rc = cli.main(["-A", "-m", "1000", "--bam", "--journal",
+                   str(tmp_path / "j.json"), str(fa),
+                   str(tmp_path / "o.bam")])
+    assert rc == 1 and "--journal" in capsys.readouterr().err
+    rc = cli.main(["-A", "--bam", "--fastq", str(fa),
+                   str(tmp_path / "o.bam")])
+    assert rc == 1 and "mutually exclusive" in capsys.readouterr().err
+    rc = cli.main(["-A", "-m", "1000", "--bam", str(fa),
+                   str(tmp_path / "no" / "dir" / "o.bam")])
+    assert rc == 1 and "write" in capsys.readouterr().err.lower()
+
+
 def test_quality_rises_with_pass_count(rng):
     """Mean vote-margin Q must increase with coverage (the whole point)."""
     from ccsx_tpu.consensus import whole_read
