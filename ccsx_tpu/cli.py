@@ -38,6 +38,7 @@ output         Output file.
 
 TPU extensions (long options):
 --device {auto,tpu,cpu}   --batch {auto,on,off}   --inflight <int>
+--mesh D,P                --fastq                 --bam
 --refine-iters <int>      --max-passes <int>      --window-growth {flush,grow}
 --journal <path>          --metrics <path>        --profile <dir>
 --hosts <int> --host-id <int> --coordinator <addr> --merge-shards <N>
