@@ -44,6 +44,23 @@ def test_sharded_run_merge_equals_single_host(tmp_path, rng):
     assert out.read_text() == ref.read_text()
 
 
+def test_sharded_fastq_merge_equals_single_host(tmp_path, rng):
+    """--fastq shards (4-line records) must merge byte-identically to
+    the single-process FASTQ output."""
+    zs, fa = _make_inputs(tmp_path, rng, n_holes=5)
+    ref = tmp_path / "ref.fq"
+    assert cli.main(["-A", "-m", "1000", "--fastq", "--batch", "on",
+                     str(fa), str(ref)]) == 0
+    out = tmp_path / "dist.fq"
+    for r in range(2):
+        assert cli.main(["-A", "-m", "1000", "--fastq", "--hosts", "2",
+                         "--host-id", str(r), str(fa), str(out)]) == 0
+    assert cli.main(["--merge-shards", "2", "ignored.in", str(out)]) == 0
+    assert out.read_text() == ref.read_text()
+    for r in fastx.read_fastx(str(out)):
+        assert r.qual is not None and len(r.qual) == len(r.seq)
+
+
 def test_sharded_journal_resume(tmp_path, rng):
     """A crashed rank resumes from its shard journal without re-emitting."""
     zs, fa = _make_inputs(tmp_path, rng, n_holes=6)
