@@ -300,7 +300,9 @@ class PairExecutor:
 
 
 class BatchExecutor:
-    """Groups RoundRequests by shape and runs one device round per group.
+    """Groups refine/round requests by shape, one device dispatch per
+    group (fused refinement for RefineRequests — the production window
+    protocol — and a single star round for bare RoundRequests).
 
     With more than one local device, batches are laid out over a 1-D
     ``data`` mesh (ZMW axis sharded, SURVEY.md §5.8): the jitted round is
