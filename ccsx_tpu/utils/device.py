@@ -61,10 +61,15 @@ def probe_default_backend(timeout: float | None = None,
         timeout = float(os.environ.get("CCSX_PROBE_TIMEOUT", "120"))
     if retries is None:
         retries = int(os.environ.get("CCSX_PROBE_RETRIES", "1"))
+    # the probe must EXECUTE on the device, not just enumerate: the
+    # tunnel has been observed with jax.devices() healthy while any
+    # dispatch (even a warm trivial jit) hangs forever
+    probe_src = ("import jax, numpy; jax.block_until_ready("
+                 "jax.jit(lambda a: a + 1)(numpy.ones(8)))")
     for attempt in range(retries + 1):
         try:
             r = subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
+                [sys.executable, "-c", probe_src],
                 timeout=timeout, capture_output=True,
             )
             ok = r.returncode == 0
