@@ -168,39 +168,64 @@ def _refine_step(params: AlignParams, max_ins: int, tmax: int, iters: int,
 
     @jax.jit
     def step(qs, qlens, ts, tlens, row_mask):
+        Z, P, _ = qs.shape
+
         def body(carry):
-            it, draft, dlen, fixed, ovf = carry
-            cons, ins_base, ins_votes, ncov, *_ = one_round(
-                qs, qlens, row_mask, draft, dlen)  # nwin+ unused here
+            it, draft, dlen, fixed, ovf, outs = carry
+            new = one_round(qs, qlens, row_mask, draft, dlen)
+            # a frozen hole keeps its LAST live round's outputs — for a
+            # fixpoint hole that round IS the host loop's final round
+            # (re-rounding an unchanged draft is a no-op), so carrying
+            # the outputs here is what lets the separate final round be
+            # folded away entirely
+            outs = tuple(
+                jnp.where(fixed.reshape((Z,) + (1,) * (n.ndim - 1)), o, n)
+                for o, n in zip(outs, new))
+            cons, ins_base, ins_votes, ncov = outs[:4]
             ins_out = spec_emit(ins_base, ins_votes, ncov)
             nd, nl, o = mat_v(cons, ins_out, dlen)
             # fixpoint: same length AND same padded cells == the host's
             # np.array_equal on the exact-length drafts (pads are PAD on
             # both sides, and a length change forces a cell change)
             now_fixed = (nl == dlen) & (nd == draft).all(axis=1)
-            o = ~fixed & o
-            # only non-fixed, non-overflowing holes take the new draft:
-            # an overflowed hole keeps its in-range draft/dlen and is
-            # FROZEN — its device result is discarded for a host replay,
-            # and freezing keeps the carry valid for the static shapes
-            # and stops it holding the loop open
-            grow = ~fixed & ~o
+            # the round at it == iters is the host loop's mandatory final
+            # round: its outputs are kept and nobody grows past it
+            last = it >= iters
+            # overflow only matters when the speculative draft would be
+            # consumed (it < iters); an overflowed hole keeps its
+            # in-range draft/dlen and is FROZEN — its device result is
+            # discarded for a host replay, and freezing keeps the carry
+            # valid for the static shapes and stops it holding the loop
+            # open
+            o = ~fixed & o & ~last
+            grow = ~fixed & ~o & ~now_fixed & ~last
             draft = jnp.where(grow[:, None], nd, draft)
             dlen = jnp.where(grow, nl, dlen)
-            return it + 1, draft, dlen, fixed | now_fixed | o, ovf | o
+            return (it + 1, draft, dlen, fixed | now_fixed | o | last,
+                    ovf | o, outs)
 
         def cond(carry):
-            it, _, _, fixed, _ = carry
-            return (it < iters) & ~fixed.all()
+            return ~carry[3].all()
 
         # pad holes (all-False row_mask) start frozen so they can't keep
         # the while_loop alive
         fixed0 = ~row_mask.any(axis=1)
-        ovf0 = jnp.zeros(fixed0.shape, bool)
-        _, draft, dlen, _, ovf = jax.lax.while_loop(
-            cond, body, (jnp.int32(0), ts, tlens, fixed0, ovf0))
+        ovf0 = jnp.zeros((Z,), bool)
+        outs0 = (
+            jnp.zeros((Z, tmax), jnp.uint8),            # cons
+            jnp.zeros((Z, tmax, max_ins), jnp.uint8),   # ins_base
+            jnp.zeros((Z, tmax, max_ins), jnp.int32),   # ins_votes
+            jnp.zeros((Z, tmax), jnp.int32),            # ncov
+            jnp.zeros((Z, tmax), jnp.int32),            # nwin
+            jnp.zeros((Z, P, tmax), bool),              # match
+            jnp.zeros((Z, P, tmax), jnp.uint8),         # aligned
+            jnp.zeros((Z, P, tmax), jnp.int32),         # ins_cnt
+            jnp.zeros((Z, P), jnp.int32),               # lead_ins
+        )
+        _, _, dlen, _, ovf, outs = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), ts, tlens, fixed0, ovf0, outs0))
         (cons, ins_base, ins_votes, ncov, nwin, match, aligned, ins_cnt,
-         lead_ins) = one_round(qs, qlens, row_mask, draft, dlen)
+         lead_ins) = outs
         bp, advance = jax.vmap(bp_advance)(
             match, cons, aligned, ins_cnt, lead_ins, row_mask, dlen)
         # uint8 vote/coverage compaction, as in _round_step
