@@ -85,6 +85,53 @@ def measure():
 
 
 def main():
+    """Watchdog wrapper: the tunnelled dev chip can hang mid-run even
+    after a healthy startup probe (observed 2026-07-30: ~2h outage where
+    enumeration worked but every dispatch hung).  The measurement runs
+    in a subprocess with a deadline; on timeout/failure it is retried
+    once on CPU (reduced e2e), and the last resort is an honest error
+    line — the driver must always receive its ONE JSON line."""
+    if ("--calibrate" in sys.argv
+            or os.environ.get("CCSX_BENCH_INNER") == "1"
+            or os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"):
+        # XLA:CPU cannot hang like the tunnel; run unwrapped
+        return _inner_main()
+    import subprocess
+
+    budget = float(os.environ.get("CCSX_BENCH_WATCHDOG", "720"))
+    here = os.path.abspath(__file__)
+
+    def attempt(extra_env, timeout):
+        env = dict(os.environ, CCSX_BENCH_INNER="1", **extra_env)
+        try:
+            r = subprocess.run([sys.executable, here], env=env,
+                               timeout=timeout, capture_output=True,
+                               text=True)
+        except subprocess.TimeoutExpired:
+            print("[bench] attempt timed out; backend hung mid-run",
+                  file=sys.stderr)
+            return None
+        sys.stderr.write(r.stderr[-2000:])
+        for line in reversed(r.stdout.splitlines()):
+            if line.startswith("{"):
+                return line
+        return None
+
+    line = attempt({}, budget)
+    if line is None:
+        print("[bench] retrying on CPU with reduced e2e", file=sys.stderr)
+        line = attempt({"JAX_PLATFORMS": "cpu",
+                        "CCSX_BENCH_E2E_HOLES": "4",
+                        "CCSX_BENCH_DEADLINE": "180"}, budget / 2)
+    if line is None:
+        line = json.dumps({
+            "metric": "consensus round throughput",
+            "value": None, "unit": "zmw_windows/s", "vs_baseline": None,
+            "error": "backend hung on both TPU and CPU attempts"})
+    print(line)
+
+
+def _inner_main():
     calibrate = "--calibrate" in sys.argv
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     if calibrate:
