@@ -1,0 +1,58 @@
+"""Device MSA primitives (ops/msa.emit_insertions_jax, make_materializer)
+vs their host NumPy specs, on randomized inputs — the unit-level pins
+behind the fused-refinement bit-parity (tests/test_refine_fused.py
+exercises them only through whole windows)."""
+
+import numpy as np
+
+from ccsx_tpu.ops import banded, msa
+
+
+def test_emit_insertions_device_matches_host_random(rng):
+    R = 4
+    for case in range(25):
+        T = int(rng.integers(1, 200))
+        ncov = rng.integers(0, 65, T).astype(np.int32)
+        ins_votes = (rng.integers(0, 130, (T, R)) % (ncov[:, None] + 1)
+                     ).astype(np.int32)
+        ins_base = rng.integers(0, 4, (T, R)).astype(np.uint8)
+        for spec in (False, True):
+            want = msa.emit_insertions(ins_base, ins_votes, ncov, spec)
+            got = np.asarray(
+                msa.emit_insertions_jax(ins_base, ins_votes, ncov, spec))
+            np.testing.assert_array_equal(got, want,
+                                          err_msg=f"case {case} spec={spec}")
+
+
+def test_materializer_matches_host_random(rng):
+    R = 4
+    mat = msa.make_materializer(96, 128, R)
+    for case in range(25):
+        tlen = int(rng.integers(1, 97))
+        cons = rng.integers(0, 6, 96).astype(np.uint8)   # bases/gap/pad
+        ins_out = np.where(rng.random((96, R)) < 0.3,
+                           rng.integers(0, 4, (96, R)),
+                           msa.PAD).astype(np.uint8)
+        want = msa.materialize(cons, ins_out, tlen)
+        out, newlen, ovf = (np.asarray(x) for x in
+                            mat(cons, ins_out, np.int32(tlen)))
+        assert int(newlen) == len(want)
+        assert bool(ovf) == (len(want) > 128)
+        keep = min(len(want), 128)
+        np.testing.assert_array_equal(out[:keep], want[:keep])
+        assert (out[keep:] == banded.PAD).all()
+
+
+def test_materializer_overflow_flag(rng):
+    """Output longer than tmax_out must set the overflow flag and keep
+    the prefix exact (the executor then replays the hole on the host)."""
+    R = 4
+    mat = msa.make_materializer(96, 64, R)
+    cons = rng.integers(0, 4, 96).astype(np.uint8)       # all bases kept
+    ins_out = rng.integers(0, 4, (96, R)).astype(np.uint8)  # all emitted
+    tlen = 96
+    want = msa.materialize(cons, ins_out, tlen)          # 480 cells
+    out, newlen, ovf = (np.asarray(x) for x in
+                        mat(cons, ins_out, np.int32(tlen)))
+    assert bool(ovf) and int(newlen) == len(want) == 480
+    np.testing.assert_array_equal(out, want[:64])
