@@ -172,7 +172,7 @@ def test_quality_calibration_monotone(rng):
         "benchmarks"))
     import quality as qmod
 
-    bins = qmod.quality_calibration(rng, n_holes=8, tlen=500)
+    bins = qmod.quality_calibration(rng, n_holes=6, tlen=400)
     rates = {}
     for b in bins:
         lo = int(b["predicted_q"].split(",")[0][1:])
