@@ -123,6 +123,15 @@ def main():
         line = attempt({"JAX_PLATFORMS": "cpu",
                         "CCSX_BENCH_E2E_HOLES": "4",
                         "CCSX_BENCH_DEADLINE": "180"}, budget / 2)
+        if line is not None:
+            # mark the fallback so downstream consumers can't mistake
+            # XLA:CPU throughput for a TPU measurement/regression
+            try:
+                d = json.loads(line)
+                d["degraded"] = "tpu attempt hung; CPU-fallback numbers"
+                line = json.dumps(d)
+            except ValueError:
+                pass
     if line is None:
         line = json.dumps({
             "metric": "consensus round throughput",
@@ -177,6 +186,7 @@ def _inner_main():
         "metric": "consensus round throughput "
                   f"(Z={Z} zmw x P={P} passes x W={W} window, "
                   f"backend={jax.default_backend()})",
+        "backend": jax.default_backend(),
         "value": round(value, 3),
         "unit": "zmw_windows/s",
         # vs the 64-core projection of the native scalar CPU aligner;

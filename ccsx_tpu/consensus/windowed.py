@@ -176,8 +176,11 @@ def windowed_gen(passes: List[np.ndarray], cfg: CcsConfig):
     return codes, quals
 
 
-def consensus_windowed(passes: List[np.ndarray], cfg: CcsConfig) -> np.ndarray:
-    """Windowed consensus over oriented passes; passes[0] anchors."""
+def consensus_windowed(passes: List[np.ndarray], cfg: CcsConfig):
+    """Windowed consensus over oriented passes; passes[0] anchors.
+
+    Returns consensus codes as an np.ndarray, or a (codes, quals)
+    tuple when cfg.emit_quality is set (matching windowed_gen)."""
     sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
     return run_rounds(windowed_gen(passes, cfg), sm)
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import gzip
 import io
+import os
 import struct
 from typing import Iterator
 
@@ -275,8 +276,13 @@ class BamWriter:
     def __init__(self, path: str):
         self.path = path
         # fail fast on an unwritable path (the container itself is
-        # written at close, after hours of compute on real inputs)
-        open(path, "wb").close()
+        # written at close, after hours of compute on real inputs);
+        # the container goes to a temp path and is renamed into place
+        # at close so a crash mid-run can't leave a zero-byte,
+        # EOF-marker-less file at the final path that downstream tools
+        # would read as a complete-but-empty run
+        self._tmp = path + ".tmp"
+        open(self._tmp, "wb").close()
         self._records = []
         self._closed = False
 
@@ -296,7 +302,8 @@ class BamWriter:
         if self._closed:
             return
         self._closed = True
-        write_bam(self.path, self._records)
+        write_bam(self._tmp, self._records)
+        os.replace(self._tmp, self.path)
         self._records = []
 
 

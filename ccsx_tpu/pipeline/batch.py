@@ -207,6 +207,17 @@ def _refine_step(params: AlignParams, max_ins: int, tmax: int, iters: int,
         def cond(carry):
             return ~carry[3].all()
 
+        # Memory note: carrying the full outs tuple (incl. the (Z,P,tmax)
+        # match/aligned/ins_cnt tensors needed only by the post-loop
+        # bp_advance) keeps those buffers live across every iteration,
+        # roughly tripling the fused step's large per-pass buffers vs the
+        # unfused round.  The alternative — carry only (draft, dlen) and
+        # recompute the kept round once after the loop (one_round is pure,
+        # and a frozen hole's draft/dlen stop changing, so the recompute
+        # reproduces the kept outputs exactly) — costs one extra full
+        # round of compute per window (~1/(iters+1) e2e).  On v5e the Z
+        # buckets fit comfortably, so we spend the memory; flip to the
+        # recompute form if a larger chip/bucket ever OOMs here.
         # pad holes (all-False row_mask) start frozen so they can't keep
         # the while_loop alive
         fixed0 = ~row_mask.any(axis=1)
