@@ -134,8 +134,23 @@ def run_pipeline_sharded(in_path: str, out_path: str, cfg: CcsConfig,
         return 1
 
     metrics = Metrics(verbose=cfg.verbose, stream=cfg.metrics_stream())
-    return drive_batched(shard_stream(stream, rank, n), writer, cfg,
-                         journal, metrics, inflight or cfg.zmw_microbatch)
+    import contextlib
+
+    import jax
+
+    # Under a live jax.distributed control plane the default sharding
+    # spans ALL processes' devices, which would turn every jit dispatch
+    # into a cross-host SPMD program (and device_put would require
+    # identical inputs on every host).  The hosts here are share-nothing
+    # (round-robin hole ownership), so pin this host's dispatch to its
+    # own devices; the per-host mesh already spans local chips only
+    # (BatchExecutor.__init__).
+    ctx = (jax.default_device(jax.local_devices()[0])
+           if jax.process_count() > 1 else contextlib.nullcontext())
+    with ctx:
+        return drive_batched(shard_stream(stream, rank, n), writer, cfg,
+                             journal, metrics,
+                             inflight or cfg.zmw_microbatch)
 
 
 def merge_shards(out_path: str, n: int, cleanup: bool = True) -> int:

@@ -355,7 +355,12 @@ class BatchExecutor:
         self._sm = StarMsa(cfg.align, cfg.max_ins_per_col,
                            cfg.len_bucket_quant)
         self._mesh = None
-        n_dev = len(jax.devices())
+        # LOCAL devices only: hosts in a distributed run are share-nothing
+        # (round-robin hole ownership, distributed.py), so each host's
+        # mesh spans its own chips (ICI); a global mesh would make every
+        # jit a cross-host SPMD program requiring identical inputs on all
+        # processes.  Single-process: local == global, nothing changes.
+        n_dev = len(jax.local_devices())
         if n_dev > 1:
             # (data, pass) mesh: ZMWs shard over 'data'; MSA rows of each
             # hole shard over 'pass' when the pass bucket divides (GSPMD
@@ -369,7 +374,7 @@ class BatchExecutor:
             from ccsx_tpu.parallel.mesh import build_mesh
 
             self._mesh = build_mesh(shape=shape,
-                                    devices=jax.devices()[:ndev_used])
+                                    devices=jax.local_devices()[:ndev_used])
             self._data_dim, self._pass_dim = shape
             if (self._pass_dim > 1
                     and all(b % self._pass_dim for b in cfg.pass_buckets)):
@@ -731,7 +736,10 @@ def mesh_precheck(cfg: CcsConfig) -> int:
     import jax
 
     try:
-        BatchExecutor.validate_mesh(cfg.mesh_shape, len(jax.devices()))
+        # local devices: the per-host mesh never spans hosts (see
+        # BatchExecutor.__init__)
+        BatchExecutor.validate_mesh(cfg.mesh_shape,
+                                    len(jax.local_devices()))
     except ValueError as e:
         print(f"Error: invalid --mesh: {e}", file=sys.stderr)
         return 1

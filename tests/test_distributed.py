@@ -1,8 +1,16 @@
 """Multi-host sharding (parallel/distributed.py): round-robin ownership,
-shard writing, k-way merge, CLI wiring.  Ranks are simulated as sequential
-processes in one test process — the sharding logic is a pure function of
-(rank, n), so this exercises exactly what real hosts run (collectives are
-exercised separately by __graft_entry__.dryrun_multichip)."""
+shard writing, k-way merge, CLI wiring.  Most ranks are simulated as
+sequential processes in one test process — the sharding logic is a pure
+function of (rank, n), so this exercises exactly what real hosts run
+(collectives are exercised separately by __graft_entry__.dryrun_multichip).
+test_two_process_coordinator_run additionally executes the REAL control
+plane: two concurrent OS processes rendezvous through
+jax.distributed.initialize on a localhost coordinator."""
+
+import os
+import socket
+import subprocess
+import sys
 
 import numpy as np
 
@@ -59,6 +67,49 @@ def test_sharded_fastq_merge_equals_single_host(tmp_path, rng):
     assert out.read_text() == ref.read_text()
     for r in fastx.read_fastx(str(out)):
         assert r.qual is not None and len(r.qual) == len(r.seq)
+
+
+def test_two_process_coordinator_run(tmp_path, rng):
+    """The real jax.distributed control plane (SURVEY.md §5.8): two
+    concurrent OS processes initialize through a localhost coordinator
+    (cli --coordinator -> init_distributed, distributed.py:38-54), each
+    runs its shard of the pipeline, and the merge must be byte-identical
+    to the single-host batched output.  This is the seam no sequential
+    simulation covers — jax.process_index()/process_count() come from
+    the coordination service, not from CLI flags."""
+    zs, fa = _make_inputs(tmp_path, rng, n_holes=4, tlen=500)
+    ref = tmp_path / "ref.fa"
+    assert cli.main(["-A", "-m", "1000", "--batch", "on",
+                     str(fa), str(ref)]) == 0
+
+    with socket.socket() as s:  # pick a free localhost port
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    out = tmp_path / "dist.fa"
+    # the runner re-asserts platforms=cpu before any backend init: the
+    # axon TPU plugin overrides JAX_PLATFORMS at import time (conftest)
+    runner = (
+        "import sys, jax; jax.config.update('jax_platforms', 'cpu'); "
+        "from ccsx_tpu.cli import main; sys.exit(main(sys.argv[1:]))")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", CCSX_SKIP_PROBE="1",
+               XLA_FLAGS="")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", runner, "-A", "-m", "1000",
+             "--hosts", "2", "--host-id", str(r),
+             "--coordinator", f"127.0.0.1:{port}", str(fa), str(out)],
+            env=env, cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for r in range(2)]
+    outs = [p.communicate(timeout=300) for p in procs]
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, f"rank failed:\n{so}\n{se}"
+    # both ranks went through the coordination service
+    assert (tmp_path / "dist.fa.shard0").exists()
+    assert (tmp_path / "dist.fa.shard1").exists()
+    assert dist.merge_shards(str(out), 2) == ref.read_text().count(">")
+    assert out.read_text() == ref.read_text()
 
 
 def test_sharded_journal_resume(tmp_path, rng):
