@@ -80,6 +80,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
                                           c.POINTER(c.c_uint8), c.c_int64]
     lib.ccsx_writer_close.restype = c.c_int
     lib.ccsx_writer_close.argtypes = [c.c_void_p]
+    lib.ccsx_bgzf_pool_bench.restype = c.c_double
+    lib.ccsx_bgzf_pool_bench.argtypes = [c.c_char_p, c.c_int, c.c_int]
     lib.ccsx_align_scalar.restype = c.c_int
     lib.ccsx_align_scalar.argtypes = [
         c.POINTER(c.c_uint8), c.c_int64, c.POINTER(c.c_uint8), c.c_int64,

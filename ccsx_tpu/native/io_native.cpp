@@ -24,6 +24,8 @@
 #include <zlib.h>
 
 #include <algorithm>
+#include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
@@ -894,6 +896,57 @@ int ccsx_writer_close(void* h) {
   bool ok = w->close();
   delete w;
   return ok ? 0 : -1;
+}
+
+// ---- BGZF pool bench (decoupled from the reader) -------------------------
+
+// Pre-reads every compressed block of a BGZF file into memory, then times
+// `threads` workers inflating the whole set with atomic work-claiming (the
+// same claim discipline as the reference's kt_for, kthread.c:39) — no file
+// IO, no record parse, no ordered hand-off.  This isolates the inflate
+// pool's scaling from everything BgzfMT::next_block interleaves with it,
+// so the curve measures the pool, not the reader (SURVEY §7.3 item 6).
+// Returns best-of-`iters` uncompressed MB/s, or -1 on a malformed file.
+double ccsx_bgzf_pool_bench(const char* path, int threads, int iters) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1.0;
+  BgzfMT rd;
+  rd.f = f;
+  std::vector<std::shared_ptr<BgzfMT::Job>> jobs;
+  uint64_t total = 0;
+  while (auto j = rd.read_raw()) {
+    total += j->isize;
+    jobs.push_back(std::move(j));
+  }
+  bool bad = rd.err;
+  fclose(f);
+  rd.f = nullptr;
+  if (bad || jobs.empty() || total == 0) return -1.0;
+  if (threads < 1) threads = 1;
+  if (iters < 1) iters = 1;
+  double best = 0.0;
+  for (int it = 0; it < iters; it++) {
+    std::atomic<size_t> next{0};
+    std::atomic<bool> ok{true};
+    auto run = [&] {
+      for (;;) {
+        size_t i = next.fetch_add(1);
+        if (i >= jobs.size()) return;
+        if (!BgzfMT::inflate_job(jobs[i].get())) ok = false;
+      }
+    };
+    auto t0 = std::chrono::steady_clock::now();
+    std::vector<std::thread> ws;
+    for (int t = 1; t < threads; t++) ws.emplace_back(run);
+    run();
+    for (auto& t : ws) t.join();
+    double dt = std::chrono::duration<double>(
+                    std::chrono::steady_clock::now() - t0)
+                    .count();
+    if (!ok.load()) return -1.0;
+    if (dt > 0) best = std::max(best, total / dt / (1 << 20));
+  }
+  return best;
 }
 
 // ---- encode / reverse-complement (main.c:222-241, seqio.h:120-148) ------
