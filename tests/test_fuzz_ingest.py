@@ -1,0 +1,278 @@
+"""Fuzz the native ingest parsers against the pure-Python oracles.
+
+The hand-rolled native parsers (BGZF header walk io_native.cpp, BAM
+record bounds, FASTQ state machine) previously had happy-path plus a few
+targeted truncation tests; this corpus (VERDICT r3 item 7) runs >=50
+deterministic mutations — bit flips, truncations at arbitrary offsets,
+garbage splices, and targeted corruptions (BC subfield, oversized ISIZE,
+mid-record EOF, malformed read names) — through BOTH readers and holds
+them to a differential contract:
+
+  * neither reader may crash the process (a native segfault kills
+    pytest — that IS the detector);
+  * every record the two readers both produce must be identical: the
+    shorter record list must be a prefix of the longer (the readers may
+    legitimately detect corruption at different points — e.g. the native
+    BGZF layer is stricter: per-block CRC + EOF-marker truncation
+    detection, io_native.cpp — but they must never DISAGREE about bytes
+    they both parsed);
+  * when both complete cleanly the outputs must be equal in full.
+
+Reference semantics being pinned: bamlite.c:135-165 record parse,
+kseq.h:177-218 FASTA/Q state machine, seqio.h:167-172 name splitting.
+"""
+
+from __future__ import annotations
+
+import gzip
+
+import numpy as np
+import pytest
+
+from ccsx_tpu import native
+from ccsx_tpu.io import bam as bam_mod
+from ccsx_tpu.io import fastx
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable")
+
+
+def _drain_native(path, is_bam):
+    from ccsx_tpu.native.io import read_records_native
+
+    recs, err = [], None
+    try:
+        for r in read_records_native(path, is_bam=is_bam):
+            recs.append((r.name, r.seq, r.qual))
+    except Exception as e:  # fuzzing: any clean Python error is fine
+        err = e
+    return recs, err
+
+
+def _drain_python(path, is_bam):
+    recs, err = [], None
+    try:
+        it = (bam_mod.read_bam_records(path) if is_bam
+              else fastx.read_fastx(path))
+        for r in it:
+            recs.append((r.name, r.seq, r.qual))
+    except Exception as e:
+        err = e
+    return recs, err
+
+
+def _check_parity(path, is_bam, label):
+    nat, nat_err = _drain_native(str(path), is_bam)
+    py, py_err = _drain_python(str(path), is_bam)
+    short, long_ = (nat, py) if len(nat) <= len(py) else (py, nat)
+    assert long_[: len(short)] == short, (
+        f"{label}: parsed-record divergence (native err={nat_err!r}, "
+        f"python err={py_err!r})")
+    if nat_err is None and py_err is None:
+        assert nat == py, f"{label}: clean runs disagree"
+    return nat_err, py_err
+
+
+# ---- base fixtures -------------------------------------------------------
+
+
+def _bam_records(n=24, seed=0):
+    rng = np.random.default_rng(seed)
+    recs = []
+    for i in range(n):
+        ln = int(rng.integers(40, 400))
+        seq = rng.choice(list(b"ACGT"), ln).astype(np.uint8).tobytes()
+        qual = bytes(33 + rng.integers(0, 60, ln, dtype=np.uint8))
+        recs.append((f"mv/{i // 4}/{i}_{i + ln}", seq, qual,
+                     (("np", "i", i), ("rq", "f", 0.99),
+                      ("zm", "i", i // 4))))
+    return recs
+
+
+def _fastq_bytes(n=30, seed=1):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        ln = int(rng.integers(30, 300))
+        seq = rng.choice(list(b"ACGT"), ln).astype(np.uint8).tobytes()
+        qual = bytes(33 + rng.integers(0, 60, ln, dtype=np.uint8))
+        out.append(b"@mv/%d/%d_%d extra comment\n%s\n+\n%s\n"
+                   % (i // 3, i, i + ln, seq, qual))
+    return b"".join(out)
+
+
+def _fasta_bytes(n=20, seed=2):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        ln = int(rng.integers(50, 500))
+        seq = rng.choice(list(b"ACGT"), ln).astype(np.uint8).tobytes()
+        # multi-line bodies exercise the kseq continuation path
+        body = b"\n".join(seq[j: j + 70] for j in range(0, ln, 70))
+        out.append(b">mv/%d/%d_%d\n%s\n" % (i // 3, i, i + ln, body))
+    return b"".join(out)
+
+
+# ---- corpus generators ---------------------------------------------------
+
+
+def _bitflip(data: bytes, seed: int) -> bytes:
+    rng = np.random.default_rng(seed)
+    raw = bytearray(data)
+    pos = int(rng.integers(0, len(raw)))
+    raw[pos] ^= 1 << int(rng.integers(0, 8))
+    return bytes(raw)
+
+
+def _truncate(data: bytes, seed: int) -> bytes:
+    rng = np.random.default_rng(seed)
+    return data[: int(rng.integers(1, len(data)))]
+
+
+def _splice(data: bytes, seed: int) -> bytes:
+    rng = np.random.default_rng(seed)
+    pos = int(rng.integers(0, len(data)))
+    junk = rng.integers(0, 256, 4, dtype=np.uint8).tobytes()
+    return data[:pos] + junk + data[pos:]
+
+
+def test_fuzz_bgzf_bam_corpus(tmp_path):
+    """36 mutated BGZF BAM files: bit flips, truncations, splices."""
+    base = tmp_path / "base.bam"
+    bam_mod.write_bam(str(base), _bam_records(), bgzf=True)
+    data = base.read_bytes()
+    n_err = 0
+    for i in range(36):
+        mut = (_bitflip, _truncate, _splice)[i % 3](data, 1000 + i)
+        p = tmp_path / f"m{i}.bam"
+        p.write_bytes(mut)
+        nat_err, py_err = _check_parity(p, True, f"bgzf-bam[{i}]")
+        n_err += nat_err is not None
+    # sanity: the corpus actually stressed the error paths
+    assert n_err >= 5
+
+
+def test_fuzz_plain_gzip_bam_corpus(tmp_path):
+    """Plain-gzip BAM container (bamlite.h:13-19 path): 12 mutations of
+    the DECOMPRESSED payload re-gzipped, hitting the BAM record walk
+    itself rather than the container CRC."""
+    payload_src = tmp_path / "src.bam"
+    bam_mod.write_bam(str(payload_src), _bam_records(n=16, seed=3),
+                      bgzf=False)
+    payload = gzip.decompress(payload_src.read_bytes())
+    for i in range(12):
+        mut = (_bitflip, _truncate, _splice)[i % 3](payload, 2000 + i)
+        p = tmp_path / f"m{i}.bam"
+        p.write_bytes(gzip.compress(mut))
+        _check_parity(p, True, f"gz-bam[{i}]")
+
+
+def test_fuzz_fastq_corpus(tmp_path):
+    """18 mutated FASTQ files through the state machine (kseq.h
+    semantics): flips corrupt bases/names, truncations produce
+    mid-record EOF (including inside the '+' quality section)."""
+    data = _fastq_bytes()
+    for i in range(18):
+        mut = (_bitflip, _truncate, _splice)[i % 3](data, 3000 + i)
+        p = tmp_path / f"m{i}.fq"
+        p.write_bytes(mut)
+        _check_parity(p, False, f"fastq[{i}]")
+
+
+def test_fuzz_fasta_corpus(tmp_path):
+    """12 mutated multi-line FASTA files."""
+    data = _fasta_bytes()
+    for i in range(12):
+        mut = (_bitflip, _truncate, _splice)[i % 3](data, 4000 + i)
+        p = tmp_path / f"m{i}.fa"
+        p.write_bytes(mut)
+        _check_parity(p, False, f"fasta[{i}]")
+
+
+def test_fuzz_targeted_bgzf_corruptions(tmp_path):
+    """Targeted container attacks: BC subfield id/len garbage, BSIZE
+    lies, oversized ISIZE, EOF-marker surgery."""
+    base = tmp_path / "base.bam"
+    bam_mod.write_bam(str(base), _bam_records(n=12, seed=5), bgzf=True)
+    data = bytearray(base.read_bytes())
+
+    cases = []
+    # (a) BC subfield id corrupted in the first member header
+    c = bytearray(data)
+    c[12:14] = b"XX"
+    cases.append(("bad-BC-id", bytes(c)))
+    # (b) BSIZE smaller than the header itself
+    c = bytearray(data)
+    c[16:18] = (5).to_bytes(2, "little")
+    cases.append(("tiny-BSIZE", bytes(c)))
+    # (c) BSIZE pointing past EOF
+    c = bytearray(data)
+    c[16:18] = (0xFFFF).to_bytes(2, "little")
+    cases.append(("huge-BSIZE", bytes(c)))
+    # (d) oversized ISIZE in the first member (cap is 64KB)
+    bsize = int.from_bytes(data[16:18], "little") + 1
+    c = bytearray(data)
+    c[bsize - 4: bsize] = (1 << 24).to_bytes(4, "little")
+    cases.append(("huge-ISIZE", bytes(c)))
+    # (e) EOF marker replaced by garbage
+    c = bytearray(data)
+    c[-len(bam_mod.BGZF_EOF):] = b"\x00" * len(bam_mod.BGZF_EOF)
+    cases.append(("mangled-EOF", bytes(c)))
+    # (f) duplicate EOF marker mid-file (empty block: legal BGZF)
+    c = bytes(data[:bsize]) + bam_mod.BGZF_EOF + bytes(data[bsize:])
+    cases.append(("empty-block-mid-file", c))
+
+    for label, blob in cases:
+        p = tmp_path / f"{label}.bam"
+        p.write_bytes(blob)
+        _check_parity(p, True, label)
+    # (f) is legal: the native reader must parse it cleanly and fully
+    nat, nat_err = _drain_native(str(tmp_path / "empty-block-mid-file.bam"),
+                                 True)
+    assert nat_err is None and len(nat) == 12
+
+
+def test_fuzz_zmw_name_edge_cases(tmp_path):
+    """Malformed movie/hole/region names kill the stream in the
+    reference (seqio.h:168-172, returns -1 mid-file); both ZMW streamers
+    must agree on the holes parsed before the bad name."""
+    from ccsx_tpu.config import CcsConfig
+    from ccsx_tpu.io import zmw as zmw_mod
+    from ccsx_tpu.native.io import stream_zmws_native
+
+    # 5 subreads per hole: the default count filter keeps a hole iff it
+    # has >= min_fulllen_count + 2 = 5 records (main.c:659)
+    names = ([f"mv/1/{i}_{i + 100}" for i in range(0, 500, 100)]
+             + [f"mv/2/{i}_{i + 100}" for i in range(0, 500, 100)]
+             + ["no_slashes_at_all"]       # 1 field: fatal bad name
+             + [f"mv/3/{i}_{i + 100}" for i in range(0, 500, 100)])
+    rng = np.random.default_rng(7)
+    out = []
+    for nm in names:
+        seq = rng.choice(list(b"ACGT"), 120).astype(np.uint8).tobytes()
+        out.append(b">%s\n%s\n" % (nm.encode(), seq))
+    p = tmp_path / "z.fa"
+    p.write_bytes(b"".join(out))
+
+    cfg = CcsConfig(min_subread_len=1, is_bam=False)
+
+    def drain(stream):
+        holes, err = [], None
+        try:
+            for z in stream:
+                holes.append((z.movie, z.hole, z.total_len))
+        except Exception as e:
+            err = e
+        return holes, err
+
+    nat, nat_err = drain(stream_zmws_native(str(p), cfg))
+    py, py_err = drain(zmw_mod.stream_zmws(
+        fastx.read_fastx(str(p)), cfg))
+    assert nat == py
+    # the bad name is fatal in both (reference parity).  Only hole mv/1
+    # survives: the error is raised while mv/2 is still accumulating
+    # (the streamer's one-record lookahead hasn't seen mv/2's terminator
+    # yet), so the in-progress hole is dropped with the stream — the
+    # same mid-accumulation -1 behavior as seqio.h:168-172
+    assert nat_err is not None and py_err is not None
+    assert len(nat) == 1 and nat[0][:2] == ("mv", "1")
