@@ -96,8 +96,11 @@ def _aligner(params: AlignParams):
                 or qmax > banded_pallas.PALLAS_MAX_QMAX
                 or qmax % banded_pallas.ROWBLOCK != 0):
             return scan_f(qs, qlens, ts, tlens)
+        # with_stats=False for the kernel too: the rounds read only
+        # (moves, offs), and the slim carry (3 rows vs 7, 1-array F scan
+        # vs 3) cuts most of the kernel's per-cell op count
         return banded_pallas.batched_align_global_moves(
-            qs, qlens, ts, tlens, params,
+            qs, qlens, ts, tlens, params, with_stats=False,
             interpret=jax.default_backend() != "tpu")
 
     return f

@@ -107,22 +107,36 @@ ROWBLOCK = 8  # rows per grid step: aligned sublane tiles for loads/stores
 GBLOCK = 8    # alignments per grid step, stacked in the sublane axis
 
 
-# rows of the G-batched carry: H, E, mat, aln, Emat, Ealn, OFF
-_CHG = 7
-_G_OFF = 6
+# rows of the G-batched carry: H, E, [mat, aln, Emat, Ealn,] OFF
+_CHG = 7          # with_stats carry rows (stats-free carry is 3)
 
 
 def _kernel_g(tlen_ref, ismatch_ref, moves_ref, fin_ref,
               ch_ref, *, qmax: int, band: int, maxshift: int,
-              params: AlignParams):
+              params: AlignParams, with_stats: bool):
     """G-batched banded DP fill: GBLOCK alignments per grid step.
 
     The first kernel revision processed one alignment per grid step, so
     every VPU op ran on a (1, B) sliver — 1/8 sublane utilization, and it
     lost to XLA's vmapped scan ~5.7x.  Here GBLOCK alignments ride the
-    sublane axis: the carry is (7, G, B) VMEM scratch, all recurrence math
-    is (G, B) tiles, and per-problem row scalars (band shift d, live mask,
-    tlen) enter as (G, 1) columns broadcast across lanes.
+    sublane axis: the carry is (nch, G, B) VMEM scratch, all recurrence
+    math is (G, B) tiles, and per-problem row scalars (band shift d, live
+    mask, tlen) enter as (G, 1) columns broadcast across lanes.
+
+    ``with_stats=False`` is the consensus-round configuration (star.
+    _aligner): the rounds consume only (moves, offs) — BandedResult is
+    discarded — so the mat/aln/Emat/Ealn stat channels are dead weight.
+    Dropping them shrinks the carry 7 rows -> 3 and the F prefix scan
+    from 3 arrays to 1, cutting most of the kernel's per-cell op count
+    (the same trade ops/banded.py makes with its with_stats=False path;
+    moves/offs are bit-identical either way).
+
+    The d-shift selection is computed ONCE at shift d-1 over the carry
+    block and the d view is derived from it with a single static +1
+    shift — shift composition holds lane-for-lane except lane B-1 under
+    d == 0, which one masked select patches back to the unshifted carry.
+    This halves the select-chain cost vs materializing both views per
+    candidate d.
 
     Per-row scalars d (band shift, 0..maxshift) and live (i <= qlen) are
     BIT-PACKED into lane 0 of the ismatch input (bits 1-3 and 4; bit 0
@@ -137,25 +151,27 @@ def _kernel_g(tlen_ref, ismatch_ref, moves_ref, fin_ref,
       ismatch_ref (G, ROWBLOCK, B) int32 — bit 0 match; lane 0 carries
                   d at bits 1-3 and live at bit 4
     Outputs: moves (G, ROWBLOCK, B) uint8; fin (G, 8, B) int32 rows
-    0/1/2 = final H/mat/aln bands.
+    0/1/2 = final H/mat/aln bands (mat/aln zero when stats are off).
     """
     M, X = params.match, params.mismatch
     O, E = params.gap_open, params.gap_extend
     B = band
     G = GBLOCK
+    nch = _CHG if with_stats else 3
+    noff = nch - 1                                   # OFF row index
     r = pl.program_id(1)
     karr = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
     tlen_col = tlen_ref[:, 0:1]                      # (G, 1)
 
-    def shift_ch(ch, s):
-        """Static lane shift of the full carry: out[..., k] = ch[..., k+s],
+    def shift_blk(blk, s):
+        """Static lane shift of a carry block: out[..., k] = blk[..., k+s],
         NEG fill (matches _pad_prev in ops/banded.py).  Expressed as a
         lane rotate + iota mask: Mosaic lowers tpu.rotate natively, while
         lane-dim concatenates hit "offset mismatch on non-concat
         dimension" and never compile on real TPU."""
         if s == 0:
-            return ch
-        rolled = jnp.roll(ch, -s, axis=2)
+            return blk
+        rolled = jnp.roll(blk, -s, axis=2)
         k3 = karr[None]                              # (1, 1, B)
         if s > 0:
             return jnp.where(k3 >= B - s, NEG, rolled)
@@ -178,7 +194,9 @@ def _kernel_g(tlen_ref, ismatch_ref, moves_ref, fin_ref,
                        jnp.where(j0 == 0, 0, O + E * j0), NEG)
         E0 = jnp.full((G, B), NEG, jnp.int32)
         z = jnp.zeros((G, B), jnp.int32)
-        ch_ref[:] = jnp.stack([H0, E0, z, j0, z, j0, z], axis=0)
+        rows0 = ([H0, E0, z, j0, z, j0, z] if with_stats
+                 else [H0, E0, z])
+        ch_ref[:] = jnp.stack(rows0, axis=0)
 
     # int32 throughout: i8 sublane slices hit Mosaic relayout limits
     packed_tile = ismatch_ref[...].astype(jnp.int32)   # (G, ROWBLOCK, B)
@@ -191,19 +209,27 @@ def _kernel_g(tlen_ref, ismatch_ref, moves_ref, fin_ref,
         d_col = (lane0 >> 1) & 7
         live_col = ((lane0 >> 4) & 1) != 0           # (G, 1) bool
 
-        # select the d-shifted views of the carry (diag wants shift d-1)
-        s_diag = shift_ch(ch, -1)
-        s_up = ch
+        # select the (d-1)-shifted view of the shiftable carry rows (the
+        # diagonal predecessors), then derive the d view (the vertical
+        # predecessors) from it by one static +1 shift
+        chs = ch[:noff]
+        sel = shift_blk(chs, -1)                     # d == 0 candidate
         for dd in range(1, maxshift + 1):
-            take = (d_col == dd)[None]               # (1, G, 1)
-            s_diag = jnp.where(take, shift_ch(ch, dd - 1), s_diag)
-            s_up = jnp.where(take, shift_ch(ch, dd), s_up)
+            cand = chs if dd == 1 else shift_blk(chs, dd - 1)
+            sel = jnp.where((d_col == dd)[None], cand, sel)
+        up = shift_blk(sel, 1)
+        # composition is exact except lane B-1 under d == 0, where
+        # shift(ch, 0) keeps the carry value the +1 shift fills with NEG
+        patch = (d_col == 0) & (karr == B - 1)       # (G, B)
+        up = jnp.where(patch[None], chs, up)
 
-        Hd_diag, mat_diag, aln_diag = s_diag[0], s_diag[2], s_diag[3]
-        H_up, E_up = s_up[0], s_up[1]
-        mat_up, aln_up = s_up[2], s_up[3]
-        Emat_up, Ealn_up = s_up[4], s_up[5]
-        OFF = ch[_G_OFF] + d_col                     # this row's band offset
+        Hd_diag = sel[0]
+        H_up, E_up = up[0], up[1]
+        if with_stats:
+            mat_diag, aln_diag = sel[2], sel[3]
+            mat_up, aln_up = up[2], up[3]
+            Emat_up, Ealn_up = up[4], up[5]
+        OFF = ch[noff] + d_col                       # this row's band offset
 
         im = ismatch_tile[:, s, :]                   # (G, B) int32 0/1
         sub = X + (M - X) * im
@@ -214,25 +240,28 @@ def _kernel_g(tlen_ref, ismatch_ref, moves_ref, fin_ref,
         e_open = H_up + O + E
         e_is_open = e_open >= e_ext
         Enew = jnp.maximum(e_ext, e_open)
-        Emat = jnp.where(e_is_open, mat_up, Emat_up)
-        Ealn = jnp.where(e_is_open, aln_up, Ealn_up) + 1
+        if with_stats:
+            Emat = jnp.where(e_is_open, mat_up, Emat_up)
+            Ealn = jnp.where(e_is_open, aln_up, Ealn_up) + 1
 
         # Hd = best of diag / E
         diag_term = Hd_diag + sub
         d_wins = diag_term >= Enew
         Hd = jnp.maximum(diag_term, Enew)
-        Hmat = jnp.where(d_wins, mat_diag + im, Emat)
-        Haln = jnp.where(d_wins, aln_diag, Ealn - 1) + 1
+        if with_stats:
+            Hmat = jnp.where(d_wins, mat_diag + im, Emat)
+            Haln = jnp.where(d_wins, aln_diag, Ealn - 1) + 1
 
         # boundary lane j == 0 (global mode)
         at0 = j == 0
         b_H = O + E * i
         Hd = jnp.where(at0, b_H, Hd)
         Enew = jnp.where(at0, b_H, Enew)
-        Hmat = jnp.where(at0, 0, Hmat)
-        Haln = jnp.where(at0, i, Haln)
-        Emat = jnp.where(at0, 0, Emat)
-        Ealn = jnp.where(at0, i, Ealn)
+        if with_stats:
+            Hmat = jnp.where(at0, 0, Hmat)
+            Haln = jnp.where(at0, i, Haln)
+            Emat = jnp.where(at0, 0, Emat)
+            Ealn = jnp.where(at0, i, Ealn)
 
         # invalid lanes beyond the template
         invalid = j > tlen_col
@@ -242,30 +271,32 @@ def _kernel_g(tlen_ref, ismatch_ref, moves_ref, fin_ref,
         # F (horizontal) max-plus prefix scan, Hillis-Steele over lanes;
         # combine keeps right on ties (ops/banded.py _combine_rightmax)
         v = Hd + O - E * karr
-        fm = Hmat
-        fa = Haln - karr
+        if with_stats:
+            fm = Hmat
+            fa = Haln - karr
         step = 1
         while step < B:
             vs = shift_row(v, -step, NEG)
-            ms = shift_row(fm, -step, NEG)
-            as_ = shift_row(fa, -step, NEG)
             keep = v >= vs
+            if with_stats:
+                ms = shift_row(fm, -step, NEG)
+                as_ = shift_row(fa, -step, NEG)
+                fm = jnp.where(keep, fm, ms)
+                fa = jnp.where(keep, fa, as_)
             v = jnp.where(keep, v, vs)
-            fm = jnp.where(keep, fm, ms)
-            fa = jnp.where(keep, fa, as_)
             step *= 2
         # exclusive: shift right by one (score fill NEG, stats fill 0)
         v = shift_row(v, -1, NEG)
-        fm = shift_row(fm, -1, 0)
-        fa = shift_row(fa, -1, 0)
         F = v + E * karr
-        Fmat = fm
-        Faln = fa + karr
+        if with_stats:
+            Fmat = shift_row(fm, -1, 0)
+            Faln = shift_row(fa, -1, 0) + karr
 
         hd_wins = Hd >= F
         Hnew = jnp.maximum(Hd, F)
-        mat_new = jnp.where(hd_wins, Hmat, Fmat)
-        aln_new = jnp.where(hd_wins, Haln, Faln)
+        if with_stats:
+            mat_new = jnp.where(hd_wins, Hmat, Fmat)
+            aln_new = jnp.where(hd_wins, Haln, Faln)
 
         # moves byte
         choice = jnp.where(
@@ -277,8 +308,9 @@ def _kernel_g(tlen_ref, ismatch_ref, moves_ref, fin_ref,
         fbit = jnp.where(f_is_open, 0, FBIT_EXT).astype(jnp.uint8)
         moves_rows.append((choice | ebit | fbit)[:, None, :])
 
-        ch_new = jnp.stack(
-            [Hnew, Enew, mat_new, aln_new, Emat, Ealn, OFF], axis=0)
+        rows_new = ([Hnew, Enew, mat_new, aln_new, Emat, Ealn, OFF]
+                    if with_stats else [Hnew, Enew, OFF])
+        ch_new = jnp.stack(rows_new, axis=0)
         ch = jnp.where(live_col[None], ch_new, ch)
 
     moves_ref[...] = jnp.concatenate(moves_rows, axis=1)
@@ -287,14 +319,18 @@ def _kernel_g(tlen_ref, ismatch_ref, moves_ref, fin_ref,
     @pl.when(r == pl.num_programs(1) - 1)
     def _():
         fin_ref[:, 0, :] = ch[0]
-        fin_ref[:, 1, :] = ch[2]
-        fin_ref[:, 2, :] = ch[3]
-        fin_ref[:, 3:8, :] = jnp.zeros((G, 5, band), jnp.int32)
+        if with_stats:
+            fin_ref[:, 1, :] = ch[2]
+            fin_ref[:, 2, :] = ch[3]
+            fin_ref[:, 3:8, :] = jnp.zeros((G, 5, band), jnp.int32)
+        else:
+            fin_ref[:, 1:8, :] = jnp.zeros((G, 7, band), jnp.int32)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("params", "band", "maxshift", "interpret"))
+    static_argnames=("params", "band", "maxshift", "interpret",
+                     "with_stats"))
 def batched_align_global_moves(
     qs: jnp.ndarray,
     qlens: jnp.ndarray,
@@ -304,13 +340,17 @@ def batched_align_global_moves(
     band: int | None = None,
     maxshift: int = 4,
     interpret: bool = False,
+    with_stats: bool = True,
 ):
     """Batched global banded alignment with move emission (Pallas).
 
     Drop-in for the vmapped scan aligner used by the consensus rounds
     (consensus/star.py): same argument shapes — (..., Qmax) uint8 queries,
     (...,) lengths, (..., Tmax) uint8 templates — and the same
-    (BandedResult, moves, offs) result tuple.
+    (BandedResult, moves, offs) result tuple.  ``with_stats=False``
+    mirrors ops/banded.py's slim mode: moves/offs/score are identical,
+    BandedResult.mat/aln are zeros, and the kernel drops the stat
+    channels from its carry (the consensus rounds never read them).
     """
     B = band if band is not None else params.band
     if maxshift > 7:
@@ -361,7 +401,8 @@ def batched_align_global_moves(
     ismatch = jnp.where(lane_is0, ismatch | aux[:, :, None], ismatch)
 
     kern = functools.partial(
-        _kernel_g, qmax=qmax, band=B, maxshift=maxshift, params=params)
+        _kernel_g, qmax=qmax, band=B, maxshift=maxshift, params=params,
+        with_stats=with_stats)
     nb = qmax // ROWBLOCK
     moves, fin = pl.pallas_call(
         kern,
@@ -382,7 +423,8 @@ def batched_align_global_moves(
             jax.ShapeDtypeStruct((npad, qmax, B), jnp.uint8),
             jax.ShapeDtypeStruct((npad, 8, B), jnp.int32),
         ],
-        scratch_shapes=[pltpu.VMEM((_CHG, GBLOCK, B), jnp.int32)],
+        scratch_shapes=[pltpu.VMEM(
+            (_CHG if with_stats else 3, GBLOCK, B), jnp.int32)],
         interpret=interpret,
     )(tlens_f[:, None], ismatch)
     moves = moves[:n]
@@ -397,14 +439,17 @@ def batched_align_global_moves(
     reachable = (laneT >= 0) & (laneT < B)
     lane = jnp.clip(laneT, 0, B - 1)
     take = jax.vmap(lambda f, l: f[:, l])(fin, lane)  # (n, 8)
+    zeros = jnp.zeros(lead, jnp.int32)
     res = BandedResult(
         score=jnp.where(reachable, take[:, 0], NEG).reshape(lead),
         qb=jnp.zeros(lead, jnp.int32),
         qe=qlens_f.reshape(lead),
         tb=jnp.zeros(lead, jnp.int32),
         te=tlens_f.reshape(lead),
-        aln=jnp.where(reachable, take[:, 2], 0).reshape(lead),
-        mat=jnp.where(reachable, take[:, 1], 0).reshape(lead),
+        aln=jnp.where(reachable, take[:, 2], 0).reshape(lead)
+        if with_stats else zeros,
+        mat=jnp.where(reachable, take[:, 1], 0).reshape(lead)
+        if with_stats else zeros,
     )
     moves = moves.reshape(lead + (qmax, B))
     offs = offs.reshape(lead + (qmax,))

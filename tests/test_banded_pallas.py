@@ -106,6 +106,42 @@ def test_leading_batch_dims():
         np.asarray(r.score).ravel(), np.asarray(flat[0].score))
 
 
+def test_with_stats_false_same_moves_and_score():
+    """The slim kernel (with_stats=False — the consensus-round config,
+    star._aligner) must emit bit-identical moves/offs/score; mat/aln are
+    zeros by contract, as in ops/banded.py's with_stats=False."""
+    rng = np.random.default_rng(19)
+    Qmax, Tmax, N = 256, 256, 5
+    cases = [_random_case(rng, Qmax, Tmax) for _ in range(N)]
+    qs = np.stack([c[0] for c in cases])
+    qlens = np.array([c[1] for c in cases], np.int32)
+    ts = np.stack([c[2] for c in cases])
+    tlens = np.array([c[3] for c in cases], np.int32)
+    r1, m1, o1 = banded_pallas.batched_align_global_moves(
+        qs, qlens, ts, tlens, AlignParams(), interpret=INTERPRET)
+    r2, m2, o2 = banded_pallas.batched_align_global_moves(
+        qs, qlens, ts, tlens, AlignParams(), interpret=INTERPRET,
+        with_stats=False)
+    np.testing.assert_array_equal(np.asarray(r1.score), np.asarray(r2.score))
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    assert not np.asarray(r2.mat).any() and not np.asarray(r2.aln).any()
+    m1, m2 = np.asarray(m1), np.asarray(m2)
+    for i in range(N):
+        ql = int(qlens[i])
+        np.testing.assert_array_equal(
+            m1[i, :ql], m2[i, :ql], err_msg=f"moves mismatch, problem {i}")
+    # and against the scan spec's slim mode
+    scan_f = banded.make_batched("global", AlignParams(), with_moves=True,
+                                 with_stats=False)
+    r3, m3, o3 = scan_f(qs, qlens, ts, tlens)
+    np.testing.assert_array_equal(np.asarray(r3.score), np.asarray(r2.score))
+    np.testing.assert_array_equal(np.asarray(o3), np.asarray(o2))
+    m3 = np.asarray(m3)
+    for i in range(N):
+        ql = int(qlens[i])
+        np.testing.assert_array_equal(m3[i, :ql], m2[i, :ql])
+
+
 def test_qmax_cap():
     with pytest.raises(ValueError):
         banded_pallas.batched_align_global_moves(
