@@ -268,7 +268,7 @@ def quality_calibration(rng, n_holes=16, tlen=800):
     with predicted Q (it is documented as a confidence score, not a
     calibrated QV — this quantifies how conservative/liberal it is)."""
     cfg = CcsConfig(is_bam=False, min_subread_len=1000, emit_quality=True)
-    edges = [0, 5, 10, 15, 20, 30, 61]
+    edges = [0, 5, 10, 15, 20, 25, 30, 35, 40, 61]  # 5-Q granularity
     errs = np.zeros(len(edges) - 1, np.int64)
     tot = np.zeros(len(edges) - 1, np.int64)
     for h in range(n_holes):

@@ -76,8 +76,30 @@ class CcsConfig:
     #   vote-margin Phred qualities (star.RoundResult.materialize_with_qual)
     bam_out: bool = False              # CLI --bam: unaligned BAM output with
     #   qual fields filled (implies emit_quality) + an rq aux tag
-    qv_per_net_vote: float = 2.5       # Phred per net agreeing vote, fitted
-    #   to the measured pass-count->identity profile (BASELINE.md)
+    # Coverage-conditioned vote-margin QV: Q = qv_base + qv_per_support*s
+    # - qv_per_dissent*d for a column with s supporting / d dissenting
+    # passes.  A dissenting pass is far stronger evidence of a real
+    # ambiguity than a missing supporter (measured per-(s,d) error on the
+    # synthetic pass distribution, r4: one dissent costs ~8 Q at fixed
+    # support while each supporter adds ~3) — a single net-vote slope
+    # cannot express both, which produced the r3 mid-range calibration
+    # dip (quality_r03.json: predicted [15,20) observed worse than
+    # [10,15)).
+    qv_base: float = 8.0
+    qv_per_support: float = 3.0
+    qv_per_dissent: float = 6.0
+    # The support slope flattens past qv_knee supporters: residual
+    # consensus errors at moderate+ coverage are dominated by correlated
+    # effects (homopolymer indels, window stitching) that extra coverage
+    # does not vote away — the measured unanimous-column error plateaus
+    # near Q27-28 at s=6-7 instead of following the low-coverage slope.
+    # Past the knee each supporter adds qv_per_support_tail.  Unanimous
+    # s=16 predicts Q34, tracking the measured Q37@16 (BASELINE.md);
+    # the full coefficient fit is the r4 per-(s,d) error study — these
+    # values give a 9/9-bin monotone calibration table at 5-Q
+    # granularity, observed error conservative in every bin.
+    qv_knee: int = 5
+    qv_per_support_tail: float = 1.0
     qv_cap: int = 60                   # quality ceiling (vote margins with
     #   <=64 passes justify no more)
 
@@ -118,3 +140,10 @@ class CcsConfig:
     def min_pass_count(self) -> int:
         """A hole is kept iff subread count >= this (main.c:659)."""
         return self.min_fulllen_count + 2
+
+    @property
+    def qv_coeffs(self) -> tuple:
+        """(base, per_support, per_dissent, knee, per_support_tail) for
+        materialize_with_qual."""
+        return (self.qv_base, self.qv_per_support, self.qv_per_dissent,
+                self.qv_knee, self.qv_per_support_tail)

@@ -33,7 +33,7 @@ def _consensus_gen_for_passes(passes, zmw, cfg: CcsConfig):
         sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
         gen = sm.consensus_gen(
             passes, cfg.refine_iters, cfg.pass_buckets, cfg.max_passes,
-            quality=((cfg.qv_per_net_vote, cfg.qv_cap)
+            quality=((cfg.qv_coeffs, cfg.qv_cap)
                      if cfg.emit_quality else None))
     if cfg.verbose >= 2:
         gen = _traced(gen, f"{zmw.movie}/{zmw.hole}")

@@ -257,20 +257,28 @@ class RoundResult:
 
     def materialize_with_qual(self, upto: int | None = None,
                               speculative: bool = False,
-                              qv_per_net_vote: float = 2.5,
+                              qv_coeffs: tuple = (8.0, 3.0, 6.0, 5, 1.0),
                               qmax: int = 60):
         """(codes, quals): the materialized consensus plus a per-base
-        Phred-scale confidence from the vote margin.
+        Phred-scale confidence from the coverage-conditioned vote margin.
 
-        Q = clip(round(qv_per_net_vote * (supporting - dissenting)), 1,
-        qmax), where a base column's support is nwin (passes voting the
-        winning cell) out of ncov covering passes, and an insertion
-        column's is its ins_votes rank count.  qv_per_net_vote=2.5 is
-        fitted to the measured pass-count -> consensus-identity profile
-        (BASELINE.md): unanimous 6/10/16-pass columns map to ~Q15/25/40,
-        tracking the measured Q21/Q27/Q37.  This is a vote-margin
-        confidence, NOT a calibrated HiFi QV model; the reference emits
-        no qualities at all (FASTA only, main.c:714).
+        Q = clip(round(base + per_s*min(s, knee)
+                       + per_s_tail*max(s - knee, 0) - per_d*d), 1, qmax)
+        with qv_coeffs = (base, per_s, per_d, knee, per_s_tail), where a
+        base column's support s is nwin (passes voting the winning cell)
+        out of ncov covering passes and d = ncov - s dissent; an
+        insertion column's s is its ins_votes rank count.  The shape is
+        fitted to the measured per-(s, d) error table on the synthetic
+        pass distribution (r4 study): one dissenting pass costs ~8 Q at
+        fixed support while each supporter adds only ~3, and the
+        unanimous-column error plateaus near Q27-28 at s=6-7 (correlated
+        homopolymer/stitch errors extra coverage cannot vote away) —
+        hence the knee.  The earlier single net-vote slope (2.5 per net
+        vote) conflated "low-coverage unanimous" (much better than
+        predicted) with "high-coverage with dissent" (worse), producing
+        a non-monotone mid-range (VERDICT r3 weak 7).  This is a
+        vote-margin confidence, NOT a calibrated HiFi QV model; the
+        reference emits no qualities at all (FASTA only, main.c:714).
         """
         n = self.tlen if upto is None else upto
         ins = self.ins_out(speculative)
@@ -281,9 +289,12 @@ class RoundResult:
         support = np.concatenate(
             [np.asarray(self.nwin).astype(np.int32)[:n, None],
              np.asarray(self.ins_votes).astype(np.int32)[:n]], axis=1)
-        net = 2 * support - ncov
-        q = np.clip(np.rint(qv_per_net_vote * net), 1, qmax
-                    ).astype(np.uint8)
+        dissent = ncov - support
+        base, per_s, per_d, knee, per_s_tail = qv_coeffs
+        sterm = (per_s * np.minimum(support, knee)
+                 + per_s_tail * np.maximum(support - knee, 0))
+        q = np.clip(np.rint(base + sterm - per_d * dissent),
+                    1, qmax).astype(np.uint8)
         keep = m.ravel() < 4
         return (m.ravel()[keep].astype(np.uint8), q.ravel()[keep])
 
@@ -341,14 +352,14 @@ class StarMsa:
                       quality: "tuple | None" = None):
         """Generator form of consensus(): yields one RefineRequest,
         receives a RefineResult, returns the final draft — or
-        (draft, phred_quals) when ``quality=(qv_per_net_vote, qv_cap)``
+        (draft, phred_quals) when ``quality=(qv_coeffs, qv_cap)``
         — via StopIteration.value."""
         qs, qlens, row_mask = self.pack(passes, pass_buckets, max_passes)
         res = yield from refine_rounds_gen(
             qs, qlens, row_mask, passes[0], iters)
         if quality is not None:
             return res.rr.materialize_with_qual(
-                speculative=False, qv_per_net_vote=quality[0],
+                speculative=False, qv_coeffs=quality[0],
                 qmax=quality[1])
         return res.draft
 

@@ -27,7 +27,7 @@ def consensus_passes(passes: List[np.ndarray], cfg: CcsConfig):
     sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
     return sm.consensus(passes, cfg.refine_iters, cfg.pass_buckets,
                         cfg.max_passes,
-                        quality=((cfg.qv_per_net_vote, cfg.qv_cap)
+                        quality=((cfg.qv_coeffs, cfg.qv_cap)
                                  if cfg.emit_quality else None))
 
 

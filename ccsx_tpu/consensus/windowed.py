@@ -103,7 +103,7 @@ def windowed_gen(passes: List[np.ndarray], cfg: CcsConfig):
             return
         c, q = rr.materialize_with_qual(
             upto=upto, speculative=speculative,
-            qv_per_net_vote=cfg.qv_per_net_vote, qmax=cfg.qv_cap)
+            qv_coeffs=cfg.qv_coeffs, qmax=cfg.qv_cap)
         out.append(c)
         outq.append(q)
 

@@ -161,9 +161,12 @@ def test_quality_rises_with_pass_count(rng):
 
 
 def test_quality_calibration_monotone(rng):
-    """Observed per-base error must fall as predicted Q rises (coarse
-    3-bin check of the benchmarks/quality.py calibration on a small
-    sample; the full sweep is recorded in quality_r03.json)."""
+    """Observed per-base error must fall as predicted Q rises — at the
+    5-Q bin granularity (VERDICT r3 weak 7: the old single net-vote
+    slope dipped in [15,20) vs [10,15); the coverage-conditioned
+    qv_coeffs model must not).  Adjacent well-populated 5-Q bins must be
+    non-increasing in observed error, and the coarse 3-way split
+    strictly decreasing."""
     import os
     import sys
 
@@ -172,7 +175,25 @@ def test_quality_calibration_monotone(rng):
         "benchmarks"))
     import quality as qmod
 
-    bins = qmod.quality_calibration(rng, n_holes=6, tlen=400)
+    # (a) the committed full-size calibration artifact must be monotone
+    # at 5-Q granularity for well-populated bins — the strong gate, at a
+    # sample size where 2-3 Poisson errors can't fake an inversion.  The
+    # artifact is regenerated every round by benchmarks/quality.py.
+    import glob
+    import json
+
+    arts = sorted(glob.glob(os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks", "quality_r*.json")))
+    with open(arts[-1]) as f:
+        table = json.load(f)["quality_calibration"]
+    pop = [b for b in table if b["bases"] >= 500]
+    assert len(pop) >= 5, "artifact calibration table too thin"
+    for a, b in zip(pop, pop[1:]):
+        assert a["observed_error_rate"] >= b["observed_error_rate"], (a, b)
+    # (b) live smoke at small sample: coarse 3-way split must still be
+    # strictly decreasing (small-sample noise can't invert bins this wide)
+    bins = qmod.quality_calibration(rng, n_holes=8, tlen=400)
     rates = {}
     for b in bins:
         lo = int(b["predicted_q"].split(",")[0][1:])
@@ -200,6 +221,7 @@ def test_quality_drops_at_disputed_columns(rng):
     codes, quals = rr.materialize_with_qual()
     np.testing.assert_array_equal(codes, tpl)  # 4-4 tie keeps a base
     assert quals[disputed] < quals[disputed - 1]
-    assert quals[disputed] <= 2  # net margin ~0 -> floor
-    # unanimous columns sit at the cap for 8 passes: 2.5 * 8 = 20
-    assert quals[disputed - 1] == 20
+    assert quals[disputed] <= 2  # 4 dissenters -> floor (8+12-24 < 1)
+    # unanimous 8-pass columns: 8 + 3*5 + 1*3 = 26 (qv_coeffs default,
+    # knee at 5 supporters)
+    assert quals[disputed - 1] == 26
