@@ -69,6 +69,18 @@ def check_bit_exact(interpret: bool) -> int:
         ql = int(qlens[i])
         np.testing.assert_array_equal(
             m1[i, :ql], m2[i, :ql], err_msg=f"moves mismatch, problem {i}")
+    # and the slim kernel (the production consensus config)
+    r3, m3, o3 = banded_pallas.batched_align_global_moves(
+        qs, qlens, ts, tlens, params, interpret=interpret,
+        with_stats=False)
+    np.testing.assert_array_equal(np.asarray(r1.score), np.asarray(r3.score))
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o3))
+    m3 = np.asarray(m3)
+    for i in range(N):
+        ql = int(qlens[i])
+        np.testing.assert_array_equal(
+            m1[i, :ql], m3[i, :ql],
+            err_msg=f"slim moves mismatch, problem {i}")
     return N
 
 
@@ -172,8 +184,11 @@ def time_fill_only(impl: str, Z, P, W, tlen, warmup=5, iters=300,
 
             @jax.jit
             def fill(qs, qlens, ts, tlens):
+                # with_stats=False: the consensus-round configuration
+                # (star._aligner) — slim carry, 1-array F scan
                 return banded_pallas.batched_align_global_moves(
-                    qs, qlens, ts, tlens, params, interpret=interp)
+                    qs, qlens, ts, tlens, params, interpret=interp,
+                    with_stats=False)
         else:
             scan_f = banded.make_batched("global", params, with_moves=True,
                                          with_stats=False)
