@@ -1,0 +1,25 @@
+#!/bin/sh
+# End-of-round TPU measurement battery.  Run when the tunnel is healthy;
+# each step is its own process (the axon tunnel flips to sync dispatch
+# after any d2h transfer, so round metrics must be taken in a fresh
+# process before e2e-style transfers — see memory/axon notes).
+#
+#   sh benchmarks/tpu_battery.sh            # full battery
+#
+# Order: (1) bench.py — also re-warms the persistent compile cache for
+# the driver's end-of-round bench; (2) Pallas A/B hardware check +
+# timing; (3) per-stage round profile + jax.profiler trace; (4) e2e at
+# scale (256 holes, inflight 64).
+set -x
+cd "$(dirname "$0")/.."
+
+python bench.py | tee benchmarks/bench_tpu_r04.json
+
+python benchmarks/pallas_ab.py --mode check
+python benchmarks/pallas_ab.py --mode time --json benchmarks/pallas_ab_tpu_r04.json
+
+python benchmarks/round_profile.py --trace-dir benchmarks/trace_r04 \
+    --json benchmarks/round_profile_r04.json
+
+python benchmarks/e2e_scale.py --holes 256 --inflight 64 \
+    --json benchmarks/e2e_scale_r04.json
