@@ -11,11 +11,28 @@ consensus vote (the same representation oracle.project_to_template builds):
   lead_ins     query bases consumed before template column 0 (counted for
                cursor bookkeeping; not voted)
 
-The walk is a ``lax.while_loop`` from (qlen, tlen) back to (0, 0); batched
-with vmap it advances all alignments in lockstep, so each step is a batched
-gather from the move matrices (HBM) plus masked scatters into the
-projection arrays.  This replaces the role of bsalign's MSA materialization
-(tidy_msa_bspoa, main.c:572) — our "MSA" is the stack of these projections.
+Two implementations, bit-identical (tests/test_traceback.py):
+
+* ``make_projector`` (default) — a ``lax.scan`` over query ROWS.  The
+  key observation: a global affine traceback consumes exactly one query
+  row per DIAG/UP move, and the only multi-cell-per-row events are
+  horizontal (F) gap runs — whose lengths are a pure function of the
+  move bytes and are precomputed VECTORIZED as per-row run-lengths of
+  the F-extend bit.  With gap_open < 0, at most one F run precedes each
+  row-consuming move (an open that beats an extension implies the source
+  cell's H strictly beats its F, so the next choice cannot be LEFT
+  again); the scan still resolves twice per row as insurance.  The scan
+  carries only three scalars and emits per-row records; the projection
+  arrays are built AFTER the scan by vectorized scatters.  vs the cell
+  walk this halves the sequential depth (qlen steps instead of
+  qlen+tlen) and removes all in-loop scatters.
+* ``make_projector_reference`` — the original cell-by-cell
+  ``lax.while_loop`` from (qlen, tlen) back to (0, 0); one move byte
+  gather + masked scatters per step.  Kept as the executable spec.
+
+This replaces the role of bsalign's MSA materialization
+(tidy_msa_bspoa, main.c:572) — our "MSA" is the stack of these
+projections.
 """
 
 from __future__ import annotations
@@ -23,7 +40,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ccsx_tpu.ops.banded import EBIT_EXT, FBIT_EXT, MOVE_UP
+from ccsx_tpu.ops.banded import EBIT_EXT, FBIT_EXT, MOVE_LEFT, MOVE_UP
 
 GAP = 4
 PAD = 5
@@ -32,7 +49,125 @@ _H, _E, _F = 0, 1, 2
 
 
 def make_projector(tmax: int, max_ins: int = 4):
-    """Build a jitted projector for templates padded to ``tmax`` columns."""
+    """Build a jitted projector for templates padded to ``tmax`` columns.
+
+    Dispatches between the two bit-identical implementations:
+    ``CCSX_PROJECTOR=scan|walk`` forces one; default is the row scan on
+    TPU backends and the cell walk elsewhere (measured on XLA:CPU the
+    walk's in-loop scatters are cheap and the scan's extra gathers lose,
+    0.31s vs 0.48s at the bench shapes; the scan halves the sequential
+    depth, which is what matters on the accelerator — A/B with
+    benchmarks/round_profile.py)."""
+    import os
+
+    impl = os.environ.get("CCSX_PROJECTOR", "")
+    if impl not in ("", "scan", "walk"):
+        raise ValueError(
+            f"CCSX_PROJECTOR={impl!r}: expected 'scan' or 'walk'")
+    if impl == "" :
+        impl = "scan" if jax.default_backend() == "tpu" else "walk"
+    if impl == "walk":
+        return make_projector_reference(tmax, max_ins)
+    return make_projector_scan(tmax, max_ins)
+
+
+def make_projector_scan(tmax: int, max_ins: int = 4):
+    """The row-scan projector (see module docstring; bit-identical to
+    make_projector_reference)."""
+
+    @jax.jit
+    def project(moves, offs, q, qlen, tlen):
+        qmax = q.shape[0]
+        B = moves.shape[1]
+        mv = moves.astype(jnp.int32)
+        choice = mv & 3
+        ebit = (mv & EBIT_EXT) != 0
+        fbit = (mv & FBIT_EXT) != 0
+        # per-row consecutive F-extend run count ENDING at each lane
+        # (including the lane itself): runc[i, l] = l - (last lane <= l
+        # with fbit clear), 0 where fbit is clear
+        lanes = jnp.arange(B, dtype=jnp.int32)
+        clear_pos = jnp.where(fbit, jnp.int32(-1), lanes[None, :])
+        last_clear = jax.lax.associative_scan(jnp.maximum, clear_pos,
+                                              axis=1)
+        runc = jnp.where(fbit, lanes[None, :] - last_clear, 0)
+
+        qlen_i = qlen.astype(jnp.int32)
+        tlen_i = tlen.astype(jnp.int32)
+
+        def step(carry, xs):
+            j, state, r = carry
+            i, ch_row, eb_row, rc_row, off_row = xs
+            live = i <= qlen_i
+
+            def lane_of(jj):
+                return jnp.clip(jj - off_row, 0, B - 1)
+
+            # resolve a pending horizontal gap run (state H, choice
+            # LEFT): consume 1 + runc cells at once.  Applied twice —
+            # the second application is a no-op for gap_open < 0.
+            def resolve(jj):
+                l = lane_of(jj)
+                is_left = (state == _H) & (ch_row[l] == MOVE_LEFT) \
+                    & (jj > 0)
+                return jnp.where(is_left, jj - (1 + rc_row[l]), jj)
+
+            j1 = resolve(resolve(j))
+            l1 = lane_of(j1)
+            is_up = live & ((j1 == 0) | (state == _E)
+                            | (ch_row[l1] == MOVE_UP))
+            is_diag = live & ~is_up
+            r_emit = jnp.where(state == _E, r + 1, jnp.int32(0))
+            state_n = jnp.where(
+                is_up,
+                jnp.where(eb_row[l1] | (j1 == 0), jnp.int32(_E),
+                          jnp.int32(_H)),
+                jnp.int32(_H))
+            j_n = jnp.where(is_diag, j1 - 1, j1)
+            carry_n = (jnp.where(live, j_n, j),
+                       jnp.where(live, state_n, state),
+                       jnp.where(live, jnp.where(is_up, r_emit, 0), r))
+            return carry_n, (is_diag, is_up, j1, r_emit)
+
+        xs = (jnp.arange(1, qmax + 1, dtype=jnp.int32),
+              choice, ebit, runc, offs.astype(jnp.int32))
+        _, (is_diag, is_up, jcol, r_emit) = jax.lax.scan(
+            step, (tlen_i, jnp.int32(_H), jnp.int32(0)), xs,
+            reverse=True)
+
+        qv = q.astype(jnp.uint8)
+        # aligned: every column < tlen is either diag-written or a
+        # deletion (GAP); scatter conflicts are impossible (each diag
+        # consumes a distinct column); dead rows write a dump slot
+        cols = jnp.arange(tmax, dtype=jnp.int32)
+        aligned0 = jnp.where(cols < tlen_i, jnp.uint8(GAP),
+                             jnp.uint8(PAD))
+        aligned = jnp.concatenate([aligned0, jnp.zeros((1,), jnp.uint8)])
+        a_idx = jnp.where(is_diag, jcol - 1, tmax)
+        aligned = aligned.at[a_idx].set(qv)[:tmax]
+
+        # insertions: slot j holds bases inserted after template column
+        # j-1 (slot 0 = leading); one vertical run per slot, so a row's
+        # stored position is min(k, max_ins)-1-r with k the run length
+        s_idx = jnp.where(is_up, jcol, tmax + 1)
+        ins_cnt_full = jnp.zeros((tmax + 2,), jnp.int32).at[s_idx].add(
+            is_up.astype(jnp.int32))
+        k_row = ins_cnt_full[s_idx]
+        kept = is_up & (r_emit < max_ins)
+        pos = jnp.clip(jnp.minimum(k_row, max_ins) - 1 - r_emit,
+                       0, max_ins - 1)
+        b_slot = jnp.where(kept, s_idx, tmax + 1)
+        ins_b_full = jnp.full((tmax + 2, max_ins), PAD, jnp.uint8)
+        ins_b_full = ins_b_full.at[b_slot, pos].set(qv)
+        return (aligned, ins_cnt_full[1:tmax + 1],
+                ins_b_full[1:tmax + 1], ins_cnt_full[0])
+
+    return project
+
+
+def make_projector_reference(tmax: int, max_ins: int = 4):
+    """The original cell-by-cell walk (executable spec for the scan
+    projector; one move-byte gather + masked scatters per step)."""
 
     @jax.jit
     def project(moves, offs, q, qlen, tlen):
