@@ -104,6 +104,39 @@ def test_cli_batched_equals_per_hole(tmp_path, rng):
     assert o_ref.read_text().count(">") == 4
 
 
+def test_cli_batched_scan_projector_equals_walk(tmp_path, rng, monkeypatch):
+    """CCSX_PROJECTOR=scan (the TPU-default row-scan traceback,
+    ops/traceback.make_projector_scan) through the FULL fused batched
+    pipeline must be byte-identical to the walk default — integration
+    coverage for the composition (vmap inside _refine_step's while_loop)
+    that unit differential tests can't see."""
+    import functools
+
+    from ccsx_tpu.consensus import star
+    from ccsx_tpu.pipeline import batch as batch_mod
+
+    zs, fa = _make_inputs(tmp_path, rng, n_holes=3, tlen=1100)
+    o_ref = tmp_path / "ref.fq"
+    o_scan = tmp_path / "scan.fq"
+    args = ["-A", "-m", "1000", "--fastq", "--batch", "on"]
+    assert cli.main(args + [str(fa), str(o_ref)]) == 0
+
+    def clear():
+        for fn in (star._projector, batch_mod._round_body,
+                   batch_mod._round_step, batch_mod._refine_step):
+            fn.cache_clear()
+
+    clear()  # projector impl is read when the builders run
+    monkeypatch.setenv("CCSX_PROJECTOR", "scan")
+    try:
+        assert cli.main(args + [str(fa), str(o_scan)]) == 0
+    finally:
+        monkeypatch.undo()
+        clear()
+    assert o_ref.read_text() == o_scan.read_text()
+    assert o_ref.read_text().count("@") >= 3
+
+
 def test_cli_batched_whole_read_equals_per_hole(tmp_path, rng):
     zs, fa = _make_inputs(tmp_path, rng, n_holes=3)
     o_ref = tmp_path / "ref.fa"
