@@ -110,8 +110,6 @@ def test_cli_batched_scan_projector_equals_walk(tmp_path, rng, monkeypatch):
     pipeline must be byte-identical to the walk default — integration
     coverage for the composition (vmap inside _refine_step's while_loop)
     that unit differential tests can't see."""
-    import functools
-
     from ccsx_tpu.consensus import star
     from ccsx_tpu.pipeline import batch as batch_mod
 
@@ -119,16 +117,21 @@ def test_cli_batched_scan_projector_equals_walk(tmp_path, rng, monkeypatch):
     o_ref = tmp_path / "ref.fq"
     o_scan = tmp_path / "scan.fq"
     args = ["-A", "-m", "1000", "--fastq", "--batch", "on"]
-    assert cli.main(args + [str(fa), str(o_ref)]) == 0
 
     def clear():
         for fn in (star._projector, batch_mod._round_body,
                    batch_mod._round_step, batch_mod._refine_step):
             fn.cache_clear()
 
+    # pin BOTH runs explicitly: the unset-env default resolves to scan
+    # on TPU backends, which would make ref-vs-scan vacuous there (and a
+    # pre-set CCSX_PROJECTOR would pollute the baseline)
     clear()  # projector impl is read when the builders run
-    monkeypatch.setenv("CCSX_PROJECTOR", "scan")
+    monkeypatch.setenv("CCSX_PROJECTOR", "walk")
     try:
+        assert cli.main(args + [str(fa), str(o_ref)]) == 0
+        clear()
+        monkeypatch.setenv("CCSX_PROJECTOR", "scan")
         assert cli.main(args + [str(fa), str(o_scan)]) == 0
     finally:
         monkeypatch.undo()
