@@ -113,7 +113,7 @@ _CHG = 7          # with_stats carry rows (stats-free carry is 3)
 
 def _kernel_g(tlen_ref, ismatch_ref, moves_ref, fin_ref,
               ch_ref, *, qmax: int, band: int, maxshift: int,
-              params: AlignParams, with_stats: bool):
+              params: AlignParams, with_stats: bool, gblock: int):
     """G-batched banded DP fill: GBLOCK alignments per grid step.
 
     The first kernel revision processed one alignment per grid step, so
@@ -156,7 +156,7 @@ def _kernel_g(tlen_ref, ismatch_ref, moves_ref, fin_ref,
     M, X = params.match, params.mismatch
     O, E = params.gap_open, params.gap_extend
     B = band
-    G = GBLOCK
+    G = gblock
     nch = _CHG if with_stats else 3
     noff = nch - 1                                   # OFF row index
     r = pl.program_id(1)
@@ -327,10 +327,6 @@ def _kernel_g(tlen_ref, ismatch_ref, moves_ref, fin_ref,
             fin_ref[:, 1:8, :] = jnp.zeros((G, 7, band), jnp.int32)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("params", "band", "maxshift", "interpret",
-                     "with_stats"))
 def batched_align_global_moves(
     qs: jnp.ndarray,
     qlens: jnp.ndarray,
@@ -341,6 +337,7 @@ def batched_align_global_moves(
     maxshift: int = 4,
     interpret: bool = False,
     with_stats: bool = True,
+    gblock: int | None = None,
 ):
     """Batched global banded alignment with move emission (Pallas).
 
@@ -351,7 +348,45 @@ def batched_align_global_moves(
     mirrors ops/banded.py's slim mode: moves/offs/score are identical,
     BandedResult.mat/aln are zeros, and the kernel drops the stat
     channels from its carry (the consensus rounds never read them).
+    ``gblock`` overrides the per-grid-step problem block (default
+    GBLOCK=8 = one native VPU sublane tile; 16/32 trade VMEM for fewer
+    grid steps — CCSX_PALLAS_GBLOCK env for A/B sweeps).  The env var is
+    resolved HERE, outside the jit boundary, so flipping it between
+    calls retraces with the new value.
     """
+    if gblock is None:
+        import os
+
+        raw = os.environ.get("CCSX_PALLAS_GBLOCK", "")
+        try:
+            gblock = int(raw) if raw else GBLOCK
+        except ValueError:
+            raise ValueError(
+                f"CCSX_PALLAS_GBLOCK={raw!r}: expected an integer >= 1")
+    if gblock < 1:
+        raise ValueError(
+            f"gblock/CCSX_PALLAS_GBLOCK must be >= 1, got {gblock}")
+    return _batched_align_impl(
+        qs, qlens, ts, tlens, params=params, band=band, maxshift=maxshift,
+        interpret=interpret, with_stats=with_stats, gblock=gblock)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("params", "band", "maxshift", "interpret",
+                     "with_stats", "gblock"))
+def _batched_align_impl(
+    qs: jnp.ndarray,
+    qlens: jnp.ndarray,
+    ts: jnp.ndarray,
+    tlens: jnp.ndarray,
+    params: AlignParams,
+    band: int | None,
+    maxshift: int,
+    interpret: bool,
+    with_stats: bool,
+    gblock: int,
+):
     B = band if band is not None else params.band
     if maxshift > 7:
         # d rides lane 0 of the ismatch tile in bits 1-3 (see _kernel_g)
@@ -370,8 +405,8 @@ def batched_align_global_moves(
     ts_f = ts.reshape(n, ts.shape[-1])
     tlens_f = tlens.reshape(n).astype(jnp.int32)
 
-    # pad the problem axis to a GBLOCK multiple (pad rows: qlen 0, tlen 0)
-    npad = -(-n // GBLOCK) * GBLOCK
+    # pad the problem axis to a gblock multiple (pad rows: qlen 0, tlen 0)
+    npad = -(-n // gblock) * gblock
     if npad != n:
         pad = npad - n
         qs_f = jnp.concatenate(
@@ -402,21 +437,21 @@ def batched_align_global_moves(
 
     kern = functools.partial(
         _kernel_g, qmax=qmax, band=B, maxshift=maxshift, params=params,
-        with_stats=with_stats)
+        with_stats=with_stats, gblock=gblock)
     nb = qmax // ROWBLOCK
     moves, fin = pl.pallas_call(
         kern,
-        grid=(npad // GBLOCK, nb),
+        grid=(npad // gblock, nb),
         in_specs=[
-            pl.BlockSpec((GBLOCK, 1), lambda i, r: (i, 0),
+            pl.BlockSpec((gblock, 1), lambda i, r: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((GBLOCK, ROWBLOCK, B), lambda i, r: (i, r, 0),
+            pl.BlockSpec((gblock, ROWBLOCK, B), lambda i, r: (i, r, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((GBLOCK, ROWBLOCK, B), lambda i, r: (i, r, 0),
+            pl.BlockSpec((gblock, ROWBLOCK, B), lambda i, r: (i, r, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((GBLOCK, 8, B), lambda i, r: (i, 0, 0),
+            pl.BlockSpec((gblock, 8, B), lambda i, r: (i, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
@@ -424,7 +459,7 @@ def batched_align_global_moves(
             jax.ShapeDtypeStruct((npad, 8, B), jnp.int32),
         ],
         scratch_shapes=[pltpu.VMEM(
-            (_CHG if with_stats else 3, GBLOCK, B), jnp.int32)],
+            (_CHG if with_stats else 3, gblock, B), jnp.int32)],
         interpret=interpret,
     )(tlens_f[:, None], ismatch)
     moves = moves[:n]

@@ -142,6 +142,31 @@ def test_with_stats_false_same_moves_and_score():
         np.testing.assert_array_equal(m3[i, :ql], m2[i, :ql])
 
 
+def test_gblock_override_bit_exact():
+    """A non-default problem block (gblock=16, the A/B sweep knob) must
+    not change any output."""
+    rng = np.random.default_rng(23)
+    Qmax, Tmax, N = 128, 128, 20   # N > gblock to exercise padding
+    cases = [_random_case(rng, Qmax, Tmax, tmin=40, tspan=60)
+             for _ in range(N)]
+    qs = np.stack([c[0] for c in cases])
+    qlens = np.array([c[1] for c in cases], np.int32)
+    ts = np.stack([c[2] for c in cases])
+    tlens = np.array([c[3] for c in cases], np.int32)
+    r1, m1, o1 = banded_pallas.batched_align_global_moves(
+        qs, qlens, ts, tlens, AlignParams(), interpret=INTERPRET,
+        with_stats=False)
+    r2, m2, o2 = banded_pallas.batched_align_global_moves(
+        qs, qlens, ts, tlens, AlignParams(), interpret=INTERPRET,
+        with_stats=False, gblock=16)
+    np.testing.assert_array_equal(np.asarray(r1.score), np.asarray(r2.score))
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    m1, m2 = np.asarray(m1), np.asarray(m2)
+    for i in range(N):
+        ql = int(qlens[i])
+        np.testing.assert_array_equal(m1[i, :ql], m2[i, :ql])
+
+
 def test_qmax_cap():
     with pytest.raises(ValueError):
         banded_pallas.batched_align_global_moves(
