@@ -233,7 +233,19 @@ def main():
     ap.add_argument("--tlen", type=int, default=1000)
     ap.add_argument("--mode", choices=["time", "check", "both"],
                     default="both")
+    ap.add_argument("--gblocks", default="",
+                    help="comma list, e.g. 8,16,32: also sweep the "
+                         "kernel's problem block (fill-only)")
     args = ap.parse_args()
+    # validate up front: a malformed list must not crash AFTER the
+    # expensive timing block and lose its results
+    try:
+        gblock_list = [int(x) for x in args.gblocks.split(",") if x]
+    except ValueError:
+        ap.error(f"--gblocks {args.gblocks!r}: expected a comma "
+                 "list of integers")
+    if any(g < 1 for g in gblock_list):
+        ap.error(f"--gblocks values must be >= 1: {gblock_list}")
 
     sys.path.insert(0, _REPO)
     from ccsx_tpu.utils.device import resolve_device
@@ -282,6 +294,32 @@ def main():
                   "zmw_windows/s (median), fill "
                   f"{out[f'fill_{impl}']['dp_cells_per_sec']:.3e} cells/s",
                   file=sys.stderr)
+
+    if args.mode in ("time", "both") and gblock_list:
+        # gblock sweep, fill-only.  NB the env is read at TRACE time of
+        # the cached @jax.jit fill closure in time_fill_only — it is the
+        # _STEP_CACHE.pop that forces a fresh closure (fresh jit cache)
+        # per value; without it every g would re-time the first kernel.
+        prior = os.environ.get("CCSX_PALLAS_GBLOCK")
+        out["fill_pallas_gblock"] = {}
+        try:
+            for g in gblock_list:
+                os.environ["CCSX_PALLAS_GBLOCK"] = str(g)
+                _STEP_CACHE.pop(("fill", "pallas"), None)
+                fr = sorted(
+                    time_fill_only("pallas", args.Z, args.P, args.W,
+                                   args.tlen, iters=50, repeats=3),
+                    key=lambda d: d["dp_cells_per_sec"])
+                out["fill_pallas_gblock"][g] = fr[len(fr) // 2]
+                print(f"pallas gblock={g}: "
+                      f"{fr[len(fr) // 2]['dp_cells_per_sec']:.3e} cells/s",
+                      file=sys.stderr)
+        finally:
+            if prior is None:
+                os.environ.pop("CCSX_PALLAS_GBLOCK", None)
+            else:
+                os.environ["CCSX_PALLAS_GBLOCK"] = prior
+            _STEP_CACHE.pop(("fill", "pallas"), None)
 
     if args.mode in ("check", "both"):
         n = check_bit_exact(interpret)

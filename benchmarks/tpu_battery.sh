@@ -16,7 +16,8 @@ cd "$(dirname "$0")/.."
 python bench.py | tee benchmarks/bench_tpu_r04.json
 
 python benchmarks/pallas_ab.py --mode check
-python benchmarks/pallas_ab.py --mode time --json benchmarks/pallas_ab_tpu_r04.json
+python benchmarks/pallas_ab.py --mode time --gblocks 8,16,32 \
+    --json benchmarks/pallas_ab_tpu_r04.json
 
 python benchmarks/round_profile.py --trace-dir benchmarks/trace_r04 \
     --json benchmarks/round_profile_r04.json
