@@ -117,36 +117,30 @@ def test_with_stats_false_same_moves_and_score():
     qlens = np.array([c[1] for c in cases], np.int32)
     ts = np.stack([c[2] for c in cases])
     tlens = np.array([c[3] for c in cases], np.int32)
-    r1, m1, o1 = banded_pallas.batched_align_global_moves(
-        qs, qlens, ts, tlens, AlignParams(), interpret=INTERPRET)
+    # compare the slim kernel against the scan spec's slim mode directly
+    # (the full-mode kernel is pinned by the _compare tests above; not
+    # re-run here to keep suite runtime down)
     r2, m2, o2 = banded_pallas.batched_align_global_moves(
         qs, qlens, ts, tlens, AlignParams(), interpret=INTERPRET,
         with_stats=False)
-    np.testing.assert_array_equal(np.asarray(r1.score), np.asarray(r2.score))
-    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
     assert not np.asarray(r2.mat).any() and not np.asarray(r2.aln).any()
-    m1, m2 = np.asarray(m1), np.asarray(m2)
-    for i in range(N):
-        ql = int(qlens[i])
-        np.testing.assert_array_equal(
-            m1[i, :ql], m2[i, :ql], err_msg=f"moves mismatch, problem {i}")
-    # and against the scan spec's slim mode
     scan_f = banded.make_batched("global", AlignParams(), with_moves=True,
                                  with_stats=False)
     r3, m3, o3 = scan_f(qs, qlens, ts, tlens)
     np.testing.assert_array_equal(np.asarray(r3.score), np.asarray(r2.score))
     np.testing.assert_array_equal(np.asarray(o3), np.asarray(o2))
-    m3 = np.asarray(m3)
+    m2, m3 = np.asarray(m2), np.asarray(m3)
     for i in range(N):
         ql = int(qlens[i])
-        np.testing.assert_array_equal(m3[i, :ql], m2[i, :ql])
+        np.testing.assert_array_equal(
+            m3[i, :ql], m2[i, :ql], err_msg=f"moves mismatch, problem {i}")
 
 
 def test_gblock_override_bit_exact():
     """A non-default problem block (gblock=16, the A/B sweep knob) must
     not change any output."""
     rng = np.random.default_rng(23)
-    Qmax, Tmax, N = 128, 128, 20   # N > gblock to exercise padding
+    Qmax, Tmax, N = 128, 128, 18   # N % 16 != 0 to exercise padding
     cases = [_random_case(rng, Qmax, Tmax, tmin=40, tspan=60)
              for _ in range(N)]
     qs = np.stack([c[0] for c in cases])
