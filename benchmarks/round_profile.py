@@ -140,8 +140,7 @@ def main():
         "backend": jax.default_backend(),
         "shapes": {"Z": Z, "P": P, "W": W, "tlen": TLEN, "band": 128},
         "banded_impl": "pallas" if star.use_pallas() else "scan",
-        "projector_impl": os.environ.get("CCSX_PROJECTOR", "")
-        or ("scan" if jax.default_backend() == "tpu" else "walk"),
+        "projector_impl": os.environ.get("CCSX_PROJECTOR", "") or "walk",
         "stage_seconds": {
             "fill": round(t_fill, 6),
             "projection": round(t_proj, 6),

@@ -21,6 +21,8 @@ python benchmarks/pallas_ab.py --mode time --gblocks 8,16,32 \
 
 python benchmarks/round_profile.py --trace-dir benchmarks/trace_r04 \
     --json benchmarks/round_profile_r04.json
+CCSX_PROJECTOR=scan python benchmarks/round_profile.py \
+    --json benchmarks/round_profile_r04_scanproj.json
 
 python benchmarks/e2e_scale.py --holes 256 --inflight 64 \
     --json benchmarks/e2e_scale_r04.json

@@ -52,23 +52,23 @@ def make_projector(tmax: int, max_ins: int = 4):
     """Build a jitted projector for templates padded to ``tmax`` columns.
 
     Dispatches between the two bit-identical implementations:
-    ``CCSX_PROJECTOR=scan|walk`` forces one; default is the row scan on
-    TPU backends and the cell walk elsewhere (measured on XLA:CPU the
-    walk's in-loop scatters are cheap and the scan's extra gathers lose,
-    0.31s vs 0.48s at the bench shapes; the scan halves the sequential
-    depth, which is what matters on the accelerator — A/B with
-    benchmarks/round_profile.py)."""
+    ``CCSX_PROJECTOR=scan|walk`` forces one; default is the cell walk.
+    Measured on XLA:CPU the walk's in-loop scatters are cheap and the
+    scan's extra gathers lose (0.31s vs 0.48s at the bench shapes); the
+    scan halves the sequential depth, which is what should matter on the
+    accelerator, but it is UNMEASURED on TPU — A/B with
+    benchmarks/round_profile.py (CCSX_PROJECTOR=scan) and flip the
+    default here if it wins.  Until then the walk default also keeps the
+    persistent compile cache for the production round programs valid."""
     import os
 
     impl = os.environ.get("CCSX_PROJECTOR", "")
     if impl not in ("", "scan", "walk"):
         raise ValueError(
             f"CCSX_PROJECTOR={impl!r}: expected 'scan' or 'walk'")
-    if impl == "" :
-        impl = "scan" if jax.default_backend() == "tpu" else "walk"
-    if impl == "walk":
-        return make_projector_reference(tmax, max_ins)
-    return make_projector_scan(tmax, max_ins)
+    if impl == "scan":
+        return make_projector_scan(tmax, max_ins)
+    return make_projector_reference(tmax, max_ins)
 
 
 def make_projector_scan(tmax: int, max_ins: int = 4):
