@@ -55,8 +55,21 @@ def make_big_bam(path, n_holes: int, rng, tlen_lo=1000, tlen_hi=5000):
     zs = []
     recs = []
     for h in range(n_holes):
+        # partial_ends: real polymerases start/end mid-molecule (the
+        # reference SKIPS these short out-of-group fragments without
+        # alignment, main.c:382 — parity says they cost nothing)
         z = synth.make_zmw(rng, int(tlens[h]), int(counts[h]),
-                           movie="mv", hole=str(h), **ERR)
+                           movie="mv", hole=str(h), partial_ends=True,
+                           **ERR)
+        if h % 5 == 0:
+            # adapter read-through: LONGER than the template group, so
+            # the reference aligns it (strand_match + clip, main.c:
+            # 392-406) and the parity break forces alignment-verified
+            # strand for the following passes — this is what drives the
+            # batched PairExecutor at scale
+            z.passes.insert(len(z.passes) // 2,
+                            synth.read_through(rng, z.template, **ERR))
+            z.strands.insert(len(z.strands) // 2, 0)
         zs.append(z)
         for name, p in zip(z.names, z.passes):
             recs.append((name, enc.decode(p).encode(), None))

@@ -99,6 +99,25 @@ def make_zmw(
                     passes=passes, strands=strands)
 
 
+def read_through(
+    rng: np.random.Generator,
+    template: np.ndarray,
+    sub_rate: float = 0.02,
+    ins_rate: float = 0.04,
+    del_rate: float = 0.04,
+) -> np.ndarray:
+    """A missed-adapter ("read-through") pass: template ++
+    revcomp(template), each half independently noisy.  ~2x the template
+    group length, so the reference's prepare stage aligns and clips it
+    to one template span (main.c:392-406) instead of trusting strand
+    parity."""
+    return np.concatenate([
+        mutate(rng, template, sub_rate, ins_rate, del_rate),
+        enc.revcomp_codes(mutate(rng, template, sub_rate, ins_rate,
+                                 del_rate)),
+    ])
+
+
 def make_fasta(zmws: List[SynthZmw]) -> str:
     return "".join(z.fasta() for z in zmws)
 

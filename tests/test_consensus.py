@@ -98,12 +98,7 @@ def test_prepare_clips_double_length_pass(rng):
     one template span (main.c:392-394)."""
     tpl = rng.integers(0, 4, 1000).astype(np.uint8)
     z = synth.make_zmw(rng, n_passes=5, template=tpl)
-    # build a double-copy pass: template + revcomp(template) noisified
-    double = np.concatenate([
-        synth.mutate(rng, tpl, 0.02, 0.04, 0.04),
-        enc.revcomp_codes(synth.mutate(rng, tpl, 0.02, 0.04, 0.04)),
-    ])
-    z.passes.append(double)
+    z.passes.append(synth.read_through(rng, tpl))
     z.strands.append(0)
     zz = _zmw_from_synth(z)
     codes = enc.encode(zz.seqs)
