@@ -2,32 +2,41 @@
 
 The north star (BASELINE.md) is >=8x vs 64-thread CPU ccsx, but the
 reference binary is not buildable offline (its bsalign dependency is
-cloned at build time, reference README.md:11).  The best CPU
-implementation available in-repo is the native C++ scalar Gotoh aligner
-(native/align_native.cpp) — the same recurrence the TPU fill computes.
-This script measures its DP cells/s single-threaded (the projection is
-linear; a threaded measure would be GIL-skewed) and writes
-bench_baseline.json with EXPLICIT projections:
+cloned at build time, reference README.md:11).  This script measures the
+CPU side of the comparison on the workload the reference actually runs
+— bsalign's banded SIMD fill (band=128, reference main.c:849) — using
+two builds of IDENTICAL native source (native/baseline_simd.cpp,
+Makefile): one vectorized (-O3 -march=native), one scalar control
+(-O2 -fno-tree-vectorize).  The artifact records:
 
-  per_core_cells_per_sec      measured, scalar C++ (-O2), this machine
-  measured_cores              always 1 (single-threaded measurement)
-  cells_per_sec_64core        per-core x 64 (linear-scaling credit)
-  cells_per_sec_64core_simd   x8 further SIMD credit — bsalign's
-                              banded-striped SSE/AVX2 lanes (reference
-                              Makefile:6-17); 8x is a generous uplift
-                              for 16-lane int8 striping after banding
-                              and dependency overhead
-  zmw_windows_per_sec_*       the same numbers in bench.py round units
-                              (one zmw-window = P x W x band DP cells)
+  per_core_cells_per_sec        measured, VECTORIZED banded fill
+  per_core_scalar_cells_per_sec measured, scalar control, same source
+  simd_factor                   MEASURED vec/scalar ratio (replaces the
+                                r1-r4 artifacts' guessed 8.0 credit;
+                                VERDICT r4 item 4)
+  gotoh_full_cells_per_sec      the old full-matrix scalar Gotoh
+                                (align_native.cpp) for artifact
+                                continuity with r1-r4
+  thread_scaling                pairs/s at 1/2/4/8 threads over the
+                                kthread-shaped pair pool (on a 1-core
+                                host this measures the host; recorded
+                                with host_cores so nobody reads it as
+                                the pool)
+  cells_per_sec_64core          per-core VECTORIZED x 64 (linear-
+                                scaling credit, the one remaining
+                                projection, stated as such)
+  zmw_windows_per_sec_*         the same numbers in bench.py round
+                                units (one zmw-window = P x W x band)
 
-bench.py reports vs_baseline against the 64-core scalar projection and
-also emits the SIMD-credited ratio, so neither a strawman nor an
-unfalsifiable claim survives in the artifact.
+bench.py reports vs_baseline against the 64-core VECTORIZED projection
+— the strongest defensible CPU number — so the north-star margin no
+longer rests on an unfalsifiable 8x guess.
 
 Usage: python benchmarks/cpu_baseline.py [--write]
 """
 
 import argparse
+import ctypes
 import json
 import os
 import sys
@@ -46,19 +55,67 @@ import bench as _bench  # noqa: E402  (repo root is on sys.path above)
 P, W = _bench.P, _bench.W
 BAND = 128  # AlignParams().band == the bench round's band
 CELLS_PER_ZMW_WINDOW = P * W * BAND
-
-SIMD_CREDIT = 8.0
 PROJECTED_CORES = 64
 
 
-def measure_native(seconds: float = 2.0, qlen: int = 1000, tlen: int = 1000):
-    """Per-core DP cells/s of the native scalar aligner.
+def _lib():
+    from ccsx_tpu import native
 
-    Measured SINGLE-threaded on purpose: the projection to 64 cores is
-    linear anyway, and a threaded measurement would be skewed by the
-    GIL-held Python fraction of each call (buffer setup + cigar decode),
-    understating the true per-core scalar rate on multi-core hosts —
-    the exact strawman effect this script exists to remove."""
+    L = native.lib()
+    if L is None:
+        raise RuntimeError("native library unavailable (build failed?)")
+    L.ccsx_banded_fill_many.restype = ctypes.c_int64
+    return L
+
+
+def measure_banded(L, vectorized, seconds=2.0, qlen=1000, tlen=1000,
+                   npairs=64):
+    """Best-of-windows banded-fill cells/s (single thread).
+
+    Best-of-3 windows: the measurement host is shared, and the scalar/
+    vec ratio must compare two best-cases, not one best-case against
+    one noise-hit (same protocol as the TPU round metric)."""
+    rng = np.random.default_rng(0)
+    qs = np.ascontiguousarray(rng.integers(0, 4, (npairs, qlen)), np.uint8)
+    ts = np.ascontiguousarray(rng.integers(0, 4, (npairs, tlen)), np.uint8)
+    pq = qs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    pt = ts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    best = 0.0
+    for _ in range(3):
+        done, t0 = 0, time.perf_counter()
+        while time.perf_counter() - t0 < seconds / 3:
+            cells = L.ccsx_banded_fill_many(
+                pq, pt, qlen, tlen, npairs, 1, int(vectorized),
+                2, -6, -3, -2, None)
+            assert cells > 0
+            done += cells
+        best = max(best, done / (time.perf_counter() - t0))
+    return best
+
+
+def measure_threads(L, qlen=1000, tlen=1000, npairs=256):
+    """Pair-pool throughput at 1/2/4/8 threads (kthread.c:48-65 shape)."""
+    rng = np.random.default_rng(1)
+    qs = np.ascontiguousarray(rng.integers(0, 4, (npairs, qlen)), np.uint8)
+    ts = np.ascontiguousarray(rng.integers(0, 4, (npairs, tlen)), np.uint8)
+    pq = qs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    pt = ts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    out = {}
+    for nt in (1, 2, 4, 8):
+        best = 0.0
+        for _ in range(2):
+            t0 = time.perf_counter()
+            cells = L.ccsx_banded_fill_many(
+                pq, pt, qlen, tlen, npairs, nt, 1, 2, -6, -3, -2, None)
+            dt = time.perf_counter() - t0
+            best = max(best, cells / dt)
+        out[f"t{nt}"] = best
+    return out
+
+
+def measure_gotoh_full(seconds=2.0, qlen=1000, tlen=1000):
+    """The r1-r4 artifact's metric (full-matrix scalar Gotoh), kept for
+    continuity so the old and new baselines are comparable."""
     from ccsx_tpu.native.align import align_scalar_native
 
     rng = np.random.default_rng(0)
@@ -66,36 +123,41 @@ def measure_native(seconds: float = 2.0, qlen: int = 1000, tlen: int = 1000):
     t = rng.integers(0, 4, tlen).astype(np.uint8)
     if align_scalar_native(q, t) is None:
         raise RuntimeError("native aligner unavailable (build failed?)")
-
     count = 0
-    stop = time.perf_counter() + seconds
     t0 = time.perf_counter()
-    while time.perf_counter() < stop:
+    while time.perf_counter() - t0 < seconds:
         align_scalar_native(q, t)
         count += 1
-    dt = time.perf_counter() - t0
-    return count * qlen * tlen / dt, 1
+    return count * qlen * tlen / (time.perf_counter() - t0)
 
 
 def build_baseline():
-    per_core, ncores = measure_native()
-    c64 = per_core * PROJECTED_CORES
-    c64s = c64 * SIMD_CREDIT
+    L = _lib()
+    vec = measure_banded(L, vectorized=True)
+    scal = measure_banded(L, vectorized=False)
+    gotoh = measure_gotoh_full()
+    threads = measure_threads(L)
+    c64 = vec * PROJECTED_CORES
     return {
-        "per_core_cells_per_sec": per_core,
-        "measured_cores": ncores,
+        "per_core_cells_per_sec": vec,
+        "per_core_scalar_cells_per_sec": scal,
+        "simd_factor": round(vec / scal, 2),
+        "gotoh_full_cells_per_sec": gotoh,
+        "measured_cores": 1,
+        "host_cores": os.cpu_count(),
+        "thread_scaling_pairs_pool_cells_per_sec": threads,
         "cells_per_sec_64core": c64,
-        "cells_per_sec_64core_simd": c64s,
         "zmw_windows_per_sec": c64 / CELLS_PER_ZMW_WINDOW,
-        "zmw_windows_per_sec_simd": c64s / CELLS_PER_ZMW_WINDOW,
         "cells_per_zmw_window": CELLS_PER_ZMW_WINDOW,
-        "simd_credit": SIMD_CREDIT,
         "projected_cores": PROJECTED_CORES,
-        "note": "native scalar Gotoh (align_native.cpp) measured on "
-                f"{ncores} core(s); 64-core and SIMD numbers are "
-                "EXPLICIT linear projections, not measurements; "
-                "zmw_windows_per_sec is the bench.py round unit "
-                "(P=8 x W=1024 x band=128 cells)",
+        "note": "banded SIMD fill (native/baseline_simd.cpp, band=128, "
+                "the bsalign-fill workload): per-core cells/s MEASURED "
+                "on the vectorized build; simd_factor is the MEASURED "
+                "vec/scalar ratio of identical source (replaces the "
+                "r1-r4 guessed 8x credit); the only remaining "
+                "projection is x64 linear core scaling, and "
+                "thread_scaling on this host measures the host "
+                f"({os.cpu_count()} core(s)), not the pool",
     }
 
 

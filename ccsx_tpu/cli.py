@@ -42,6 +42,7 @@ TPU extensions (long options):
 --refine-iters <int>      --max-passes <int>      --window-growth {flush,grow}
 --journal <path>          --metrics <path>        --profile <dir>
 --hosts <int> --host-id <int> --coordinator <addr> --merge-shards <N>
+--make-index              (index INPUT for byte-range sharded ingest)
 """
 
 
@@ -124,6 +125,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(optional; enables cross-host collectives)")
     p.add_argument("--merge-shards", type=int, default=None, metavar="N",
                    help="Merge OUTPUT.shard0..N-1 into OUTPUT and exit")
+    p.add_argument("--make-index", action="store_true",
+                   help="Build INPUT's BGZF hole index sidecar "
+                        "(<INPUT>.ccsx_idx) for byte-range sharded "
+                        "multi-host ingest, then exit")
     return p
 
 
@@ -177,6 +182,24 @@ def main(argv: Optional[list] = None) -> int:
 
     # imports deferred so --help stays fast and backend selection happens
     # after the config is known
+    if args.make_index:
+        if not cfg.is_bam:
+            print("Error: --make-index requires BAM input (BGZF "
+                  "container)", file=sys.stderr)
+            return 1
+        from ccsx_tpu.io import bam as bam_mod
+        from ccsx_tpu.io import bamindex
+
+        try:
+            idx = bamindex.build_index(args.input)
+        except (OSError, bam_mod.BamError) as e:
+            print(f"Error: --make-index failed: {e}", file=sys.stderr)
+            return 1
+        print(f"[ccsx-tpu] indexed {idx['n_holes']} holes / "
+              f"{idx['n_records']} records -> "
+              f"{args.input}{bamindex.INDEX_SUFFIX}", file=sys.stderr)
+        return 0
+
     if args.merge_shards is not None:
         from ccsx_tpu.parallel.distributed import merge_shards
 

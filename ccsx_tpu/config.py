@@ -100,6 +100,18 @@ class CcsConfig:
     # granularity, observed error conservative in every bin.
     qv_knee: int = 5
     qv_per_support_tail: float = 1.0
+    # Homopolymer-run penalty: a consensus base inside a length-R run
+    # loses qv_per_hp * min(R-1, qv_hp_cap) Q.  Fitted to the r5
+    # correlated-error study (benchmarks/quality.py, hp_factor=0.6
+    # hp_ins_same=0.7): at fixed predicted Q, observed Q drops ~6-9 per
+    # run unit because homopolymer indels are CORRELATED across passes
+    # — unanimous columns in long runs can be unanimously wrong, which
+    # vote margins cannot see.  The cap reflects the measured flattening
+    # past run ~5.  Under i.i.d. errors the penalty is merely
+    # conservative (hp columns are no worse there); under realistic
+    # correlated errors it is what keeps the calibration monotone.
+    qv_per_hp: float = 7.0
+    qv_hp_cap: int = 4
     qv_cap: int = 60                   # quality ceiling (vote margins with
     #   <=64 passes justify no more)
 
@@ -143,7 +155,8 @@ class CcsConfig:
 
     @property
     def qv_coeffs(self) -> tuple:
-        """(base, per_support, per_dissent, knee, per_support_tail) for
-        materialize_with_qual."""
+        """(base, per_support, per_dissent, knee, per_support_tail,
+        per_hp, hp_cap) for materialize_with_qual."""
         return (self.qv_base, self.qv_per_support, self.qv_per_dissent,
-                self.qv_knee, self.qv_per_support_tail)
+                self.qv_knee, self.qv_per_support_tail,
+                self.qv_per_hp, self.qv_hp_cap)

@@ -257,14 +257,24 @@ class RoundResult:
 
     def materialize_with_qual(self, upto: int | None = None,
                               speculative: bool = False,
-                              qv_coeffs: tuple = (8.0, 3.0, 6.0, 5, 1.0),
+                              qv_coeffs: tuple = (8.0, 3.0, 6.0, 5, 1.0,
+                                                  7.0, 4),
                               qmax: int = 60):
         """(codes, quals): the materialized consensus plus a per-base
         Phred-scale confidence from the coverage-conditioned vote margin.
 
         Q = clip(round(base + per_s*min(s, knee)
-                       + per_s_tail*max(s - knee, 0) - per_d*d), 1, qmax)
-        with qv_coeffs = (base, per_s, per_d, knee, per_s_tail), where a
+                       + per_s_tail*max(s - knee, 0) - per_d*d
+                       - per_hp*min(run - 1, hp_cap)), 1, qmax)
+        with qv_coeffs = (base, per_s, per_d, knee, per_s_tail[, per_hp,
+        hp_cap]) and `run` the homopolymer run length of the emitted
+        base in the FINAL consensus (insertions included): homopolymer
+        indels are correlated across passes, so a unanimous column in a
+        long run can be unanimously wrong — the r5 correlated-error
+        study (benchmarks/quality.py) measures ~6-9 observed Q lost per
+        run unit at fixed vote margin, which the penalty prices in
+        (config.py qv_per_hp discussion).  A 5-tuple disables the
+        homopolymer term (r4-compatible behavior), where a
         base column's support s is nwin (passes voting the winning cell)
         out of ncov covering passes and d = ncov - s dissent; an
         insertion column's s is its ins_votes rank count.  The shape is
@@ -290,13 +300,23 @@ class RoundResult:
             [np.asarray(self.nwin).astype(np.int32)[:n, None],
              np.asarray(self.ins_votes).astype(np.int32)[:n]], axis=1)
         dissent = ncov - support
-        base, per_s, per_d, knee, per_s_tail = qv_coeffs
+        base, per_s, per_d, knee, per_s_tail = qv_coeffs[:5]
+        per_hp, hp_cap = qv_coeffs[5:] if len(qv_coeffs) > 5 else (0.0, 0)
         sterm = (per_s * np.minimum(support, knee)
                  + per_s_tail * np.maximum(support - knee, 0))
-        q = np.clip(np.rint(base + sterm - per_d * dissent),
-                    1, qmax).astype(np.uint8)
+        q = base + sterm - per_d * dissent
         keep = m.ravel() < 4
-        return (m.ravel()[keep].astype(np.uint8), q.ravel()[keep])
+        codes = m.ravel()[keep].astype(np.uint8)
+        quals = q.ravel()[keep]
+        if per_hp and len(codes):
+            # run lengths on the emitted sequence (vectorized: a run's
+            # length broadcast to each of its members)
+            change = np.flatnonzero(np.diff(codes)) + 1
+            bounds = np.concatenate([[0], change, [len(codes)]])
+            runs = np.repeat(np.diff(bounds), np.diff(bounds))
+            quals = quals - per_hp * np.minimum(runs - 1, hp_cap)
+        return (codes,
+                np.clip(np.rint(quals), 1, qmax).astype(np.uint8))
 
 
 class StarMsa:

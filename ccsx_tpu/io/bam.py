@@ -280,9 +280,22 @@ class BamWriter:
         # the container goes to a temp path and is renamed into place
         # at close so a crash mid-run can't leave a zero-byte,
         # EOF-marker-less file at the final path that downstream tools
-        # would read as a complete-but-empty run
-        self._tmp = path + ".tmp"
-        open(self._tmp, "wb").close()
+        # would read as a complete-but-empty run.  The temp name is
+        # unique (mkstemp in the target dir, same filesystem for the
+        # rename): a fixed path+'.tmp' would leak forever after a crash
+        # and let two writers on the same output silently clobber each
+        # other's temp before the atomic rename
+        import tempfile
+
+        fd, self._tmp = tempfile.mkstemp(
+            prefix=os.path.basename(path) + ".tmp.",
+            dir=os.path.dirname(os.path.abspath(path)))
+        os.close(fd)
+        # mkstemp creates 0600; the final BAM must honor the umask like
+        # any normally-open()ed output (os.replace preserves the mode)
+        umask = os.umask(0)
+        os.umask(umask)
+        os.chmod(self._tmp, 0o666 & ~umask)
         self._records = []
         self._closed = False
 

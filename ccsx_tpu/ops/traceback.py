@@ -11,9 +11,12 @@ consensus vote (the same representation oracle.project_to_template builds):
   lead_ins     query bases consumed before template column 0 (counted for
                cursor bookkeeping; not voted)
 
-Two implementations, bit-identical (tests/test_traceback.py):
+Two implementations, bit-identical (tests/test_traceback.py).  The cell
+walk is the unconditional default on every backend until the TPU A/B
+(benchmarks/round_profile.py with CCSX_PROJECTOR=scan) flips it; the
+scan is opt-in via ``CCSX_PROJECTOR=scan``:
 
-* ``make_projector`` (default) — a ``lax.scan`` over query ROWS.  The
+* ``make_projector_scan`` (opt-in) — a ``lax.scan`` over query ROWS.  The
   key observation: a global affine traceback consumes exactly one query
   row per DIAG/UP move, and the only multi-cell-per-row events are
   horizontal (F) gap runs — whose lengths are a pure function of the
@@ -26,7 +29,7 @@ Two implementations, bit-identical (tests/test_traceback.py):
   arrays are built AFTER the scan by vectorized scatters.  vs the cell
   walk this halves the sequential depth (qlen steps instead of
   qlen+tlen) and removes all in-loop scatters.
-* ``make_projector_reference`` — the original cell-by-cell
+* ``make_projector_reference`` (default) — the original cell-by-cell
   ``lax.while_loop`` from (qlen, tlen) back to (0, 0); one move byte
   gather + masked scatters per step.  Kept as the executable spec.
 

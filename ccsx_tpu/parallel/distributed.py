@@ -19,8 +19,12 @@ kthread.c:30-223).  The TPU framework scales across hosts the JAX way:
 
 The round-robin-over-one-stream design trades redundant parsing (every
 host decodes the full input) for zero coordination; with the native C++
-reader parsing is far faster than consensus, so this is the right trade
-until per-host byte-range BAM splitting (BGZF chunking) is worth it.
+reader parsing is far faster than consensus, so it remains the default.
+For BGZF BAM inputs with a hole index sidecar (``ccsx --make-index``,
+io/bamindex.py), sharded runs switch to byte-range ingest: each host
+inflates only its ~1/N of the compressed bytes and owns a contiguous
+raw-hole range, with ordinal bookkeeping that keeps merge_shards'
+output byte-identical (metrics.ingest_bytes records each mode's cost).
 """
 
 from __future__ import annotations
@@ -69,16 +73,32 @@ def shard_path(out_path: str, rank: int) -> str:
 class ShardWriter:
     """FASTA shard + sidecar of global hole ordinals, for exact merge.
 
-    Local hole ordinal k (what drive_batched passes to put_at) maps to
-    global ordinal rank + k*n under round-robin sharding.
+    Round-robin mode (``start_ordinal`` None): local hole ordinal k
+    (what drive_batched passes to put_at) maps to global ordinal
+    rank + k*n.  Range mode (byte-range sharded BAM ingest,
+    io/bamindex.py): ordinal = start_ordinal + k — monotone across
+    ranks because a contiguous range's filtered hole count never
+    exceeds its raw width, so rank r's keys stay below rank r+1's
+    start.  Either way merge_shards' ordinal heap restores the exact
+    single-host output order.
     """
 
-    def __init__(self, out_path: str, rank: int, n: int, append: bool):
+    def __init__(self, out_path: str, rank: int, n: int, append: bool,
+                 start_ordinal: int | None = None):
         self.rank, self.n = rank, n
+        self.start_ordinal = start_ordinal
         mode = "a" if append else "w"
         self.path = shard_path(out_path, rank)
         self._f = open(self.path, mode)
         self._idx = open(self.path + ".idx", mode)
+        if not append:
+            # the sharding mode is chosen per-rank from local state (a
+            # BGZF index sidecar may be fresh on one host and stale on
+            # another); a mixed-mode run would interleave overlapping
+            # ordinal spaces into a silently corrupt merge, so each
+            # shard declares its mode and merge_shards refuses a mix
+            self._idx.write("#mode=range\n" if start_ordinal is not None
+                            else "#mode=rr\n")
 
     def put_at(self, local_idx: int, name: str, seq: bytes,
                qual: bytes | None = None) -> None:
@@ -86,7 +106,10 @@ class ShardWriter:
             self._f.write(f">{name}\n{seq.decode()}\n")
         else:
             self._f.write(f"@{name}\n{seq.decode()}\n+\n{qual.decode()}\n")
-        self._idx.write(f"{self.rank + local_idx * self.n}\n")
+        ordinal = (self.rank + local_idx * self.n
+                   if self.start_ordinal is None
+                   else self.start_ordinal + local_idx)
+        self._idx.write(f"{ordinal}\n")
 
     def put(self, name: str, seq: bytes,
             qual: bytes | None = None) -> None:  # pragma: no cover
@@ -112,8 +135,39 @@ def run_pipeline_sharded(in_path: str, out_path: str, cfg: CcsConfig,
 
     if not (0 <= rank < n):
         raise ValueError(f"rank {rank} outside [0, {n})")
+    metrics = Metrics(verbose=cfg.verbose, stream=cfg.metrics_stream())
+    # byte-range sharded ingest (SURVEY §5.8 "each host reads its own
+    # input shard"): a fresh BGZF hole index (ccsx --make-index) lets
+    # this rank inflate only its ~1/N of the compressed bytes and own a
+    # contiguous raw-hole range; without one, fall back to the
+    # zero-coordination full-parse round-robin.  Range mode streams
+    # through the Python record parser (the native prefetch streamer
+    # reads whole files); its 1/N byte share beats the native reader's
+    # full-file speed for N >= ~2 hosts.
+    range_lo = None
+    idx = None
+    if cfg.is_bam and in_path != "-" and os.path.exists(in_path):
+        from ccsx_tpu.io import bamindex
+
+        idx = bamindex.load_index(in_path)
     try:
-        stream = open_zmw_stream(in_path, cfg)
+        from ccsx_tpu.io import zmw as zmw_mod
+
+        if idx is not None:
+            range_lo, range_hi = bamindex.hole_range(
+                idx["n_holes"], rank, n)
+
+            def _count(nbytes, m=metrics):
+                m.ingest_bytes += nbytes
+
+            stream = zmw_mod.stream_zmws(
+                bamindex.read_hole_range(in_path, idx, range_lo,
+                                         range_hi, counter=_count), cfg)
+        else:
+            stream = open_zmw_stream(in_path, cfg)
+            if in_path != "-" and os.path.exists(in_path):
+                # full-parse round-robin: every host ingests the file
+                metrics.ingest_bytes = os.path.getsize(in_path)
     except (OSError, RuntimeError) as e:
         print(f"Error: Failed to open infile! ({e})", file=sys.stderr)
         return 1
@@ -125,15 +179,20 @@ def run_pipeline_sharded(in_path: str, out_path: str, cfg: CcsConfig,
     if mesh_precheck(cfg):
         return 1
     jp = f"{journal_path}.shard{rank}" if journal_path else None
-    journal = Journal.load_or_create(jp, input_id=f"{in_path}#{rank}/{n}")
+    # the input_id pins the sharding MODE too: a journal written under
+    # round-robin must not resume a range-sharded run (the ordinal
+    # spaces differ)
+    mode_id = (f"{in_path}#range{rank}/{n}" if range_lo is not None
+               else f"{in_path}#{rank}/{n}")
+    journal = Journal.load_or_create(jp, input_id=mode_id)
     try:
         writer = ShardWriter(out_path, rank, n,
-                             append=bool(journal.holes_done))
+                             append=bool(journal.holes_done),
+                             start_ordinal=range_lo)
     except OSError:
         print("Cannot open file for write!", file=sys.stderr)
         return 1
 
-    metrics = Metrics(verbose=cfg.verbose, stream=cfg.metrics_stream())
     import contextlib
 
     import jax
@@ -148,8 +207,11 @@ def run_pipeline_sharded(in_path: str, out_path: str, cfg: CcsConfig,
     ctx = (jax.default_device(jax.local_devices()[0])
            if jax.process_count() > 1 else contextlib.nullcontext())
     with ctx:
-        return drive_batched(shard_stream(stream, rank, n), writer, cfg,
-                             journal, metrics,
+        # range mode: the stream is already this rank's contiguous
+        # share; round-robin: interleave-filter the shared full stream
+        shard = (stream if range_lo is not None
+                 else shard_stream(stream, rank, n))
+        return drive_batched(shard, writer, cfg, journal, metrics,
                              inflight or cfg.zmw_microbatch)
 
 
@@ -158,9 +220,28 @@ def merge_shards(out_path: str, n: int, cleanup: bool = True) -> int:
     out_path; returns the record count.  Restores exactly the single-host
     output order."""
 
+    def shard_mode(rank: int) -> str:
+        with open(shard_path(out_path, rank) + ".idx") as fi:
+            first = fi.readline()
+        return first.strip() if first.startswith("#") else "#mode=rr"
+
+    modes = {shard_mode(r) for r in range(n)}
+    if len(modes) > 1:
+        # one rank ran byte-range sharding while another round-robined
+        # (e.g. the BGZF index sidecar was fresh on one host only):
+        # their ordinal spaces overlap, so a merge would silently drop
+        # and duplicate holes — refuse instead
+        raise ValueError(
+            f"shards disagree on sharding mode ({sorted(modes)}); "
+            "re-run all ranks with a consistent .ccsx_idx sidecar "
+            "(or none)")
+
     def records(rank: int):
         p = shard_path(out_path, rank)
         with open(p) as f, open(p + ".idx") as fi:
+            pos = fi.tell()
+            if fi.readline()[:1] != "#":
+                fi.seek(pos)   # legacy sidecar without a mode header
             while True:
                 header = f.readline()
                 if not header:

@@ -315,6 +315,10 @@ class PairExecutor:
                 qlens[z] = len(pairs[i].q)
                 tlens[z] = len(pairs[i].t)
                 ls[z] = lines[i]
+            if self.metrics is not None:
+                self.metrics.dp_cells_padded += N * qmax * self.params.band
+                self.metrics.dp_cells_real += (int(qlens.sum())
+                                               * self.params.band)
             # async-dispatch every bucket before reading any back
             pending.append((idxs, fill(qs, qlens, ts, tlens, ls)))
         for idxs, res in pending:
@@ -441,6 +445,19 @@ class BatchExecutor:
             Z = -(-Z // self._data_dim) * self._data_dim
         return Z
 
+    def _count_cells(self, reqs, idxs, P, qmax, Z, iters: int = 1):
+        """Padding accounting (metrics.dp_cells_*): real DP fill cells
+        (true qlen of real pass-rows) vs dispatched cells (the full
+        Z x P x qmax x band x iters block).  The ratio is the device
+        occupancy that bucket tuning (pass/length/Z buckets) controls —
+        SURVEY §7.3 item 2's named throughput risk, now measured."""
+        if self.metrics is None:
+            return
+        band = self.cfg.align.band
+        self.metrics.dp_cells_padded += Z * P * qmax * band * iters
+        self.metrics.dp_cells_real += band * iters * int(
+            sum(int(reqs[i].qlens[reqs[i].row_mask].sum()) for i in idxs))
+
     def _stack_group(self, reqs, idxs, P, qmax, tmax):
         """Pad + stack a shape group's requests into device inputs."""
         Z = self._round_z(len(idxs))
@@ -499,6 +516,7 @@ class BatchExecutor:
         pending = []
         for (P, qmax, tmax), idxs in groups.items():
             args = self._stack_group(requests, idxs, P, qmax, tmax)
+            self._count_cells(requests, idxs, P, qmax, args[0].shape[0])
             step = _round_step(cfg.align, cfg.max_ins_per_col, tmax,
                                self._bp_consts())
             pending.append((idxs, step(*self._shard_args(args, P))))
@@ -535,6 +553,8 @@ class BatchExecutor:
         pending = []
         for (P, qmax, tmax, iters), idxs in groups.items():
             args = self._stack_group(requests, idxs, P, qmax, tmax)
+            self._count_cells(requests, idxs, P, qmax, args[0].shape[0],
+                              iters)
             step = _refine_step(cfg.align, cfg.max_ins_per_col, tmax,
                                 iters, self._bp_consts())
             pending.append((idxs, step(*self._shard_args(args, P))))

@@ -26,6 +26,19 @@ class Metrics:
     pair_alignments: int = 0   # batched prep strand_match pairs
     device_dispatches: int = 0
     refine_overflows: int = 0  # fused windows replayed on host (rare)
+    # padding accounting for the batched device rounds (SURVEY §7.3
+    # item 2 names padding waste the main throughput risk): real = DP
+    # fill cells belonging to real pass-rows at their true qlen;
+    # padded = cells actually dispatched (Z x P x qmax x band x iters,
+    # i.e. including pad holes, pad rows, and qlen->qmax padding).
+    # occupancy = real/padded is the fraction of device fill work that
+    # was asked for.  Pair alignments (PairExecutor) are included.
+    dp_cells_real: int = 0
+    dp_cells_padded: int = 0
+    # compressed input bytes this process ingested (byte-range sharded
+    # BAM ingest reports its ~1/N share; full-parse paths report the
+    # file size).  0 when unknown (stdin / pure-stream inputs).
+    ingest_bytes: int = 0
     # per-stage wall time (SURVEY.md §5.1: the reference has no stage
     # timing; the pipeline analog of its read/compute/write steps).
     # Attribution is at the driver loop: with worker threads, t_compute
@@ -77,6 +90,12 @@ class Metrics:
             "pair_alignments": self.pair_alignments,
             "device_dispatches": self.device_dispatches,
             "refine_overflows": self.refine_overflows,
+            "dp_cells_real": self.dp_cells_real,
+            "dp_cells_padded": self.dp_cells_padded,
+            "dp_occupancy": round(self.dp_cells_real
+                                  / self.dp_cells_padded, 4)
+                            if self.dp_cells_padded else None,
+            "ingest_bytes": self.ingest_bytes,
             "ingest_s": round(self.t_ingest, 6),
             "prep_s": round(self.t_prep, 6),
             "compute_s": round(self.t_compute, 6),

@@ -40,25 +40,67 @@ class SynthZmw:
         return "".join(recs)
 
 
+def _run_lengths(seq: np.ndarray) -> np.ndarray:
+    """len of the maximal homopolymer run containing each position."""
+    n = len(seq)
+    runs = np.empty(n, np.int32)
+    i = 0
+    while i < n:
+        j = i
+        while j < n and seq[j] == seq[i]:
+            j += 1
+        runs[i:j] = j - i
+        i = j
+    return runs
+
+
 def mutate(
     rng: np.random.Generator,
     seq: np.ndarray,
     sub_rate: float,
     ins_rate: float,
     del_rate: float,
+    hp_factor: float = 0.0,
+    hp_ins_same: float = 0.0,
+    context_sub: Optional[tuple] = None,
 ) -> np.ndarray:
-    """Apply independent per-base errors to a 2-bit sequence."""
+    """Apply per-base errors to a 2-bit sequence.
+
+    Defaults are the i.i.d. model (and consume the identical rng
+    stream, so seeded fixtures are unchanged).  The optional knobs
+    model where real CCS consensus and QV calibration actually get
+    stressed — errors CORRELATED across passes at the same template
+    loci, so unanimous columns can be unanimously wrong:
+
+    * ``hp_factor`` — indel rates scale by (1 + hp_factor*min(run-1, 4))
+      inside homopolymer runs (PacBio's dominant error mode).
+    * ``hp_ins_same`` — probability an inserted base copies the current
+      base (homopolymer extension) instead of being uniform.
+    * ``context_sub`` — per-base (A,C,G,T) multiplier on sub_rate.
+    """
+    biased = hp_factor or context_sub is not None
+    runs = _run_lengths(seq) if hp_factor else None
     out = []
-    for b in seq:
+    for i, b in enumerate(seq):
+        dr, sr, ir = del_rate, sub_rate, ins_rate
+        if biased:
+            if hp_factor:
+                m = 1.0 + hp_factor * min(int(runs[i]) - 1, 4)
+                dr, ir = dr * m, ir * m
+            if context_sub is not None:
+                sr = sr * context_sub[int(b)]
         r = rng.random()
-        if r < del_rate:
+        if r < dr:
             continue
-        if r < del_rate + sub_rate:
+        if r < dr + sr:
             out.append((int(b) + 1 + rng.integers(3)) % 4)
         else:
             out.append(int(b))
-        while rng.random() < ins_rate:
-            out.append(int(rng.integers(4)))
+        while rng.random() < ir:
+            if hp_ins_same and rng.random() < hp_ins_same:
+                out.append(int(b))
+            else:
+                out.append(int(rng.integers(4)))
     return np.array(out, dtype=np.uint8)
 
 
@@ -74,6 +116,9 @@ def make_zmw(
     first_strand: int = 0,
     template: Optional[np.ndarray] = None,
     partial_ends: bool = False,
+    hp_factor: float = 0.0,
+    hp_ins_same: float = 0.0,
+    context_sub: Optional[tuple] = None,
 ) -> SynthZmw:
     """With ``partial_ends``, the first and last passes are truncated
     fragments (the polymerase starts/ends mid-molecule on real ZMWs) —
@@ -85,7 +130,9 @@ def make_zmw(
     passes, strands = [], []
     for k in range(n_passes):
         strand = (first_strand + k) % 2
-        p = mutate(rng, template, sub_rate, ins_rate, del_rate)
+        p = mutate(rng, template, sub_rate, ins_rate, del_rate,
+                   hp_factor=hp_factor, hp_ins_same=hp_ins_same,
+                   context_sub=context_sub)
         if strand:
             p = enc.revcomp_codes(p)
         if partial_ends and n_passes >= 5 and k in (0, n_passes - 1):

@@ -123,9 +123,9 @@ def test_cli_batched_scan_projector_equals_walk(tmp_path, rng, monkeypatch):
                    batch_mod._round_step, batch_mod._refine_step):
             fn.cache_clear()
 
-    # pin BOTH runs explicitly: the unset-env default resolves to scan
-    # on TPU backends, which would make ref-vs-scan vacuous there (and a
-    # pre-set CCSX_PROJECTOR would pollute the baseline)
+    # pin BOTH runs explicitly: the unset-env default is the walk on
+    # every backend (until the TPU A/B flips it), but a pre-set
+    # CCSX_PROJECTOR in the environment would pollute the baseline
     clear()  # projector impl is read when the builders run
     monkeypatch.setenv("CCSX_PROJECTOR", "walk")
     try:

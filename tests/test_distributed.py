@@ -82,9 +82,6 @@ def test_two_process_coordinator_run(tmp_path, rng):
     assert cli.main(["-A", "-m", "1000", "--batch", "on",
                      str(fa), str(ref)]) == 0
 
-    with socket.socket() as s:  # pick a free localhost port
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
     out = tmp_path / "dist.fa"
     # the runner re-asserts platforms=cpu before any backend init: the
     # axon TPU plugin overrides JAX_PLATFORMS at import time (conftest)
@@ -93,16 +90,35 @@ def test_two_process_coordinator_run(tmp_path, rng):
         "from ccsx_tpu.cli import main; sys.exit(main(sys.argv[1:]))")
     env = dict(os.environ, JAX_PLATFORMS="cpu", CCSX_SKIP_PROBE="1",
                XLA_FLAGS="")
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-c", runner, "-A", "-m", "1000",
-             "--hosts", "2", "--host-id", str(r),
-             "--coordinator", f"127.0.0.1:{port}", str(fa), str(out)],
-            env=env, cwd=os.path.dirname(os.path.dirname(
-                os.path.abspath(__file__))),
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
-        for r in range(2)]
-    outs = [p.communicate(timeout=300) for p in procs]
+    # bind-then-close port picking is TOCTOU (another process can grab
+    # the port before rank 0's coordinator binds it) — retry the whole
+    # rendezvous on a fresh port if that race hits, and always reap both
+    # subprocesses even when communicate() times out
+    for attempt in range(3):
+        with socket.socket() as s:  # pick a free localhost port
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", runner, "-A", "-m", "1000",
+                 "--hosts", "2", "--host-id", str(r),
+                 "--coordinator", f"127.0.0.1:{port}", str(fa), str(out)],
+                env=env, cwd=os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+            for r in range(2)]
+        try:
+            outs = [p.communicate(timeout=300) for p in procs]
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+        if (attempt < 2 and any(p.returncode != 0 for p in procs)
+                and any("bind" in se.lower() or "in use" in se.lower()
+                        for _, se in outs)):
+            continue  # coordinator lost the port race; fresh port
+        break
     for p, (so, se) in zip(procs, outs):
         assert p.returncode == 0, f"rank failed:\n{so}\n{se}"
     # both ranks went through the coordination service

@@ -65,3 +65,41 @@ def test_size_cap_returns_none():
     q = np.zeros(1 << 14, np.uint8)
     t = np.zeros(1 << 13, np.uint8)
     assert align_scalar_native(q, t) is None
+
+
+def test_banded_fill_vec_equals_scalar(rng):
+    """The two builds of native/baseline_simd.cpp (vectorized vs
+    -fno-tree-vectorize, identical source) must agree bit-for-bit on the
+    final band row — the precondition for reading their speed ratio as
+    a SIMD factor (bench_baseline.json, VERDICT r4 item 4)."""
+    import ctypes
+
+    from ccsx_tpu import native
+
+    L = native.lib()
+    if L is None:
+        import pytest
+
+        pytest.skip("native library unavailable")
+
+    def run(fn, q, t):
+        h = np.zeros(128, np.int16)
+        rc = fn(q.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(q),
+                t.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(t),
+                2, -6, -3, -2,
+                h.ctypes.data_as(ctypes.POINTER(ctypes.c_int16)))
+        assert rc == 0
+        return h
+
+    for _ in range(8):
+        ql = int(rng.integers(50, 2500))
+        tl = int(rng.integers(50, 2500))
+        q = rng.integers(0, 4, ql).astype(np.uint8)
+        t = rng.integers(0, 4, tl).astype(np.uint8)
+        hv = run(L.ccsx_banded_fill_vec, q, t)
+        hs = run(L.ccsx_banded_fill_scalar, q, t)
+        np.testing.assert_array_equal(hv, hs)
+    # identity alignment: the band covers the main diagonal end-to-end,
+    # so the best final-row cell is the perfect-match global score
+    q = rng.integers(0, 4, 1000).astype(np.uint8)
+    assert run(L.ccsx_banded_fill_vec, q, q).max() == 2 * 1000

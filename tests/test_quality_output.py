@@ -181,12 +181,26 @@ def test_quality_calibration_monotone(rng):
     # artifact is regenerated every round by benchmarks/quality.py.
     import glob
     import json
+    import re
 
-    arts = sorted(glob.glob(os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "benchmarks", "quality_r*.json")))
+    # newest artifact by NUMERIC round (lexicographic sort breaks at
+    # r100: quality_r100 < quality_r11)
+    arts = sorted(
+        glob.glob(os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "benchmarks", "quality_r*.json")),
+        key=lambda p: int(re.search(r"quality_r(\d+)", p).group(1)))
     with open(arts[-1]) as f:
-        table = json.load(f)["quality_calibration"]
+        art = json.load(f)
+    # the gate is only meaningful if the artifact was generated under
+    # the CURRENT qv model — a coefficient change without regeneration
+    # must fail here, not pass vacuously against stale data.  r05+
+    # artifacts always record qv_coeffs (benchmarks/quality.py).
+    from ccsx_tpu.config import CcsConfig
+    assert art.get("qv_coeffs") == list(CcsConfig(is_bam=False).qv_coeffs), (
+        "stale calibration artifact: regenerate benchmarks/quality_r*.json "
+        "after changing qv coefficients")
+    table = art["quality_calibration"]
     pop = [b for b in table if b["bases"] >= 500]
     assert len(pop) >= 5, "artifact calibration table too thin"
     for a, b in zip(pop, pop[1:]):
