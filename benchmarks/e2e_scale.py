@@ -128,6 +128,14 @@ def run_scale(n_holes: int, inflight: int, rng, device: str = "auto",
                 final["device_dispatches"] / max(len(got), 1), 2),
             "refine_overflows": final["refine_overflows"],
             "pair_alignments": final["pair_alignments"],
+            # padding accounting (SURVEY §7.3 item 2): the fraction of
+            # dispatched DP fill cells that belong to real pass-rows at
+            # true qlen — what pass/length/Z bucket tuning controls
+            "dp_cells_real": final["dp_cells_real"],
+            "dp_cells_padded": final["dp_cells_padded"],
+            "dp_occupancy": final["dp_occupancy"],
+            "dp_pass_fill": final["dp_pass_fill"],
+            "dp_z_fill": final["dp_z_fill"],
             "stage_seconds": {k: final[k] for k in
                               ("ingest_s", "prep_s", "compute_s",
                                "write_s")},

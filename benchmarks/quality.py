@@ -280,14 +280,15 @@ def quality_calibration(rng, n_holes=16, tlen=800, err=None):
     with predicted Q (it is documented as a confidence score, not a
     calibrated QV — this quantifies how conservative/liberal it is).
     ``err`` selects the error model (default module ERR)."""
-    err = dict(ERR if err is None else err)
+    err_model = dict(ERR if err is None else err)
     cfg = CcsConfig(is_bam=False, min_subread_len=1000, emit_quality=True)
     edges = [0, 5, 10, 15, 20, 25, 30, 35, 40, 61]  # 5-Q granularity
     errs = np.zeros(len(edges) - 1, np.int64)
     tot = np.zeros(len(edges) - 1, np.int64)
     for h in range(n_holes):
         npass = int(sample_pass_counts(rng, 1)[0])
-        z = synth.make_zmw(rng, tlen, npass, movie="mv", hole=str(h), **err)
+        z = synth.make_zmw(rng, tlen, npass, movie="mv", hole=str(h),
+                           **err_model)
         lens = np.array([len(p) for p in z.passes], np.int32)
         offs = np.zeros(len(lens), np.int32)
         if len(lens) > 1:
@@ -346,26 +347,44 @@ def main():
            # calibration gate (tests/test_quality_output.py) can detect
            # a stale artifact after a coefficient change
            "qv_coeffs": list(CcsConfig(is_bam=False).qv_coeffs)}
+    def save():
+        # checkpoint after every section: a timed-out run still leaves
+        # the completed sections on disk (a full 100-hole run is >1h on
+        # a contended 1-core host; losing the gate to a late crash once
+        # cost this exact artifact a full regeneration)
+        if a.json:
+            with open(a.json + ".partial", "w") as f:
+                json.dump(res, f, indent=1)
+
     res["error_models"] = {"iid": ERR, "biased": ERR_BIASED}
-    res["gate"] = [run_gate_config(c, a.holes, rng) for c in (1, 2, 3, 4, 5)]
+    res["gate"] = []
+    for c in (1, 2, 3, 4, 5):
+        res["gate"].append(run_gate_config(c, a.holes, rng))
+        save()
     # realistic correlated errors on the config-1 shape: the yield the
     # framework would report on homopolymer-heavy real data
     res["gate_biased"] = run_gate_config(1, a.holes, rng, err=ERR_BIASED)
+    save()
     res["sweep_max_window"] = sweep_max_window(
         rng, n_holes=8 if a.full else 4)
+    save()
     res["sweep_max_passes"] = sweep_max_passes(
         rng, n_holes=6 if a.full else 3)
+    save()
     # primary gated table: the CORRELATED model (tests/
     # test_quality_output.py asserts monotone at 5-Q granularity);
     # i.i.d. table kept for continuity with the r3/r4 artifacts
     res["quality_calibration"] = quality_calibration(
         rng, n_holes=64 if a.full else 16, err=ERR_BIASED)
+    save()
     res["quality_calibration_iid"] = quality_calibration(
         rng, n_holes=64 if a.full else 16)
     print(json.dumps(res, indent=1))
     if a.json:
         with open(a.json, "w") as f:
             json.dump(res, f, indent=1)
+        if os.path.exists(a.json + ".partial"):
+            os.remove(a.json + ".partial")
 
 
 if __name__ == "__main__":

@@ -16,16 +16,16 @@ cd "$(dirname "$0")/.."
 # priority order for a short recovery window: the round number + cache
 # warm first, then the scale evidence (VERDICT r3 item 2), then A/B and
 # profiles
-python bench.py | tee benchmarks/bench_tpu_r04.json
+python bench.py | tee benchmarks/bench_tpu_r05.json
 
 python benchmarks/e2e_scale.py --holes 256 --inflight 64 \
-    --json benchmarks/e2e_scale_r04.json
+    --json benchmarks/e2e_scale_r05.json
 
 python benchmarks/pallas_ab.py --mode check
 python benchmarks/pallas_ab.py --mode time --gblocks 8,16,32 \
-    --json benchmarks/pallas_ab_tpu_r04.json
+    --json benchmarks/pallas_ab_tpu_r05.json
 
-python benchmarks/round_profile.py --trace-dir benchmarks/trace_r04 \
-    --json benchmarks/round_profile_r04.json
+python benchmarks/round_profile.py --trace-dir benchmarks/trace_r05 \
+    --json benchmarks/round_profile_r05.json
 CCSX_PROJECTOR=scan python benchmarks/round_profile.py \
-    --json benchmarks/round_profile_r04_scanproj.json
+    --json benchmarks/round_profile_r05_scanproj.json

@@ -264,17 +264,15 @@ class RoundResult:
         Phred-scale confidence from the coverage-conditioned vote margin.
 
         Q = clip(round(base + per_s*min(s, knee)
-                       + per_s_tail*max(s - knee, 0) - per_d*d
-                       - per_hp*min(run - 1, hp_cap)), 1, qmax)
+                       + per_s_tail*max(s - knee, 0) - per_d*d), 1, qmax)
         with qv_coeffs = (base, per_s, per_d, knee, per_s_tail[, per_hp,
-        hp_cap]) and `run` the homopolymer run length of the emitted
-        base in the FINAL consensus (insertions included): homopolymer
-        indels are correlated across passes, so a unanimous column in a
-        long run can be unanimously wrong — the r5 correlated-error
-        study (benchmarks/quality.py) measures ~6-9 observed Q lost per
-        run unit at fixed vote margin, which the penalty prices in
-        (config.py qv_per_hp discussion).  A 5-tuple disables the
-        homopolymer term (r4-compatible behavior), where a
+        hp_cap]).  The homopolymer coefficients (positions 5-6) are NOT
+        applied here: run lengths must be computed on the FINAL
+        assembled consensus, and the windowed path materializes one
+        chunk at a time (a run spanning a window breakpoint would be
+        split and under-penalized) — callers apply
+        ``apply_hp_penalty`` after assembly (run_rounds in windowed.py,
+        consensus_gen below).  Here a
         base column's support s is nwin (passes voting the winning cell)
         out of ncov covering passes and d = ncov - s dissent; an
         insertion column's s is its ins_votes rank count.  The shape is
@@ -301,22 +299,40 @@ class RoundResult:
              np.asarray(self.ins_votes).astype(np.int32)[:n]], axis=1)
         dissent = ncov - support
         base, per_s, per_d, knee, per_s_tail = qv_coeffs[:5]
-        per_hp, hp_cap = qv_coeffs[5:] if len(qv_coeffs) > 5 else (0.0, 0)
         sterm = (per_s * np.minimum(support, knee)
                  + per_s_tail * np.maximum(support - knee, 0))
         q = base + sterm - per_d * dissent
         keep = m.ravel() < 4
         codes = m.ravel()[keep].astype(np.uint8)
-        quals = q.ravel()[keep]
-        if per_hp and len(codes):
-            # run lengths on the emitted sequence (vectorized: a run's
-            # length broadcast to each of its members)
-            change = np.flatnonzero(np.diff(codes)) + 1
-            bounds = np.concatenate([[0], change, [len(codes)]])
-            runs = np.repeat(np.diff(bounds), np.diff(bounds))
-            quals = quals - per_hp * np.minimum(runs - 1, hp_cap)
-        return (codes,
-                np.clip(np.rint(quals), 1, qmax).astype(np.uint8))
+        return (codes, np.clip(np.rint(q.ravel()[keep]),
+                               1, qmax).astype(np.uint8))
+
+
+def apply_hp_penalty(codes: np.ndarray, quals: np.ndarray,
+                     qv_coeffs: tuple) -> np.ndarray:
+    """Homopolymer-run QV penalty on the FINAL assembled consensus.
+
+    Q -= per_hp * min(run - 1, hp_cap) with `run` the homopolymer run
+    length containing each emitted base (insertions included), then
+    re-clipped to >= 1.  Homopolymer indels are correlated across
+    passes, so a unanimous column in a long run can be unanimously
+    wrong — the r5 correlated-error study (benchmarks/quality.py)
+    measures ~6-9 observed Q lost per run unit at fixed vote margin
+    (config.py qv_per_hp discussion).  Applied after chunk assembly —
+    NOT inside materialize_with_qual — so runs spanning window
+    breakpoints are penalized at their true length; the whole-read and
+    windowed paths therefore agree on quals for the same sequence.
+    A 5-tuple qv_coeffs (r4 behavior) is a no-op."""
+    per_hp, hp_cap = qv_coeffs[5:7] if len(qv_coeffs) > 5 else (0.0, 0)
+    if not per_hp or not len(codes):
+        return quals
+    # vectorized run lengths: each run's length broadcast to its members
+    change = np.flatnonzero(np.diff(codes)) + 1
+    bounds = np.concatenate([[0], change, [len(codes)]])
+    runs = np.repeat(np.diff(bounds), np.diff(bounds))
+    q = quals.astype(np.int32) - np.rint(
+        per_hp * np.minimum(runs - 1, hp_cap)).astype(np.int32)
+    return np.maximum(q, 1).astype(np.uint8)
 
 
 class StarMsa:
@@ -378,9 +394,10 @@ class StarMsa:
         res = yield from refine_rounds_gen(
             qs, qlens, row_mask, passes[0], iters)
         if quality is not None:
-            return res.rr.materialize_with_qual(
+            codes, quals = res.rr.materialize_with_qual(
                 speculative=False, qv_coeffs=quality[0],
                 qmax=quality[1])
+            return codes, apply_hp_penalty(codes, quals, quality[0])
         return res.draft
 
     def consensus(self, passes: List[np.ndarray], iters: int,

@@ -32,7 +32,7 @@ import numpy as np
 from ccsx_tpu.config import CcsConfig
 from ccsx_tpu.consensus import prepare as prep
 from ccsx_tpu.consensus.star import (
-    RoundResult, StarMsa, refine_rounds_gen, run_rounds,
+    RoundResult, StarMsa, apply_hp_penalty, refine_rounds_gen, run_rounds,
 )
 from ccsx_tpu.ops import encode as enc
 
@@ -173,7 +173,10 @@ def windowed_gen(passes: List[np.ndarray], cfg: CcsConfig):
     if not cfg.emit_quality:
         return codes
     quals = np.concatenate(outq) if outq else np.zeros(0, np.uint8)
-    return codes, quals
+    # hp penalty AFTER window assembly: a homopolymer run spanning a
+    # window breakpoint must be penalized at its true length, not as
+    # two split halves (star.apply_hp_penalty)
+    return codes, apply_hp_penalty(codes, quals, cfg.qv_coeffs)
 
 
 def consensus_windowed(passes: List[np.ndarray], cfg: CcsConfig):

@@ -114,27 +114,39 @@ def read_bam_records(path_or_file, with_aux: bool = False):
             raise BamError("truncated BAM: partial block size")
         (block_size,) = struct.unpack("<i", head)
         block = _read_exact(f, block_size, "alignment block")
-        (refid, pos, l_read_name, mapq, bin_, n_cigar, flag, l_seq,
-         next_ref, next_pos, tlen) = struct.unpack("<iiBBHHHiiii", block[:32])
-        off = 32
-        name = block[off:off + l_read_name - 1].decode(errors="replace")
-        off += l_read_name
-        off += 4 * n_cigar
-        nseq_bytes = (l_seq + 1) // 2
-        packed = np.frombuffer(block, dtype=np.uint8,
-                               count=nseq_bytes, offset=off)
-        seq = _NIB[packed].reshape(-1)[:l_seq].tobytes()
-        off += nseq_bytes
-        qual_raw = np.frombuffer(block, dtype=np.uint8, count=l_seq,
-                                 offset=off)
-        # phred+33 clamped at 126 (seqio.h:113)
-        qual = np.minimum(qual_raw.astype(np.int16) + 33, 126).astype(
-            np.uint8).tobytes()
-        rec = FastxRecord(name=name, comment="", seq=seq, qual=qual)
+        rec, aux_buf = decode_record(block)
         if with_aux:
-            yield rec, parse_aux(block[off + l_seq:])
+            yield rec, parse_aux(aux_buf)
         else:
             yield rec
+
+
+def decode_record(block: bytes):
+    """One alignment block -> (FastxRecord, aux_region_bytes).
+
+    THE record decode — name, 4-bit packed sequence via the
+    =ACMGRSVTWYHKDBN table (seqio.h:92), qualities phred+33 clamped at
+    126 (seqio.h:113).  Shared by the sequential reader above and the
+    byte-range sharded reader (io/bamindex.py) so the two streams can
+    never diverge in decode semantics."""
+    (refid, pos, l_read_name, mapq, bin_, n_cigar, flag, l_seq,
+     next_ref, next_pos, tlen) = struct.unpack("<iiBBHHHiiii", block[:32])
+    off = 32
+    name = block[off:off + l_read_name - 1].decode(errors="replace")
+    off += l_read_name
+    off += 4 * n_cigar
+    nseq_bytes = (l_seq + 1) // 2
+    packed = np.frombuffer(block, dtype=np.uint8,
+                           count=nseq_bytes, offset=off)
+    seq = _NIB[packed].reshape(-1)[:l_seq].tobytes()
+    off += nseq_bytes
+    qual_raw = np.frombuffer(block, dtype=np.uint8, count=l_seq,
+                             offset=off)
+    # phred+33 clamped at 126 (seqio.h:113)
+    qual = np.minimum(qual_raw.astype(np.int16) + 33, 126).astype(
+        np.uint8).tobytes()
+    return (FastxRecord(name=name, comment="", seq=seq, qual=qual),
+            block[off + l_seq:])
 
 
 # ---- aux-tag walk (bamlite.c:215-290) ------------------------------------

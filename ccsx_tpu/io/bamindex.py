@@ -272,9 +272,7 @@ def read_hole_range(path: str, idx: dict, lo: int, hi: int,
 
 
 def _range_records(r, lo, hi, holes_seen, prev_key):
-    import numpy as np
-
-    from ccsx_tpu.io.bam import _NIB
+    from ccsx_tpu.io.bam import decode_record
 
     while True:
         head = r.read(4)
@@ -295,19 +293,7 @@ def _range_records(r, lo, hi, holes_seen, prev_key):
             if holes_seen >= hi:
                 return
         if holes_seen < lo:
-            continue
-        # full decode (same semantics as bam.read_bam_records)
-        (refid, pos, l_read_name, mapq, bin_, n_cigar, flag,
-         l_seq, next_ref, next_pos, tl) = struct.unpack(
-            "<iiBBHHHiiii", block[:32])
-        off = 32 + l_read_name + 4 * n_cigar
-        nseq_bytes = (l_seq + 1) // 2
-        packed = np.frombuffer(block, dtype=np.uint8,
-                               count=nseq_bytes, offset=off)
-        seq = _NIB[packed].reshape(-1)[:l_seq].tobytes()
-        off += nseq_bytes
-        qual_raw = np.frombuffer(block, dtype=np.uint8, count=l_seq,
-                                 offset=off)
-        qual = np.minimum(qual_raw.astype(np.int16) + 33, 126).astype(
-            np.uint8).tobytes()
-        yield FastxRecord(name=name, comment="", seq=seq, qual=qual)
+            continue   # lead-in hole: name-only parse, no seq decode
+        # full decode shared with the sequential reader (bam.py) so the
+        # range-sharded stream can never diverge from it
+        yield decode_record(block)[0]

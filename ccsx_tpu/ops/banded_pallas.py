@@ -35,6 +35,24 @@ Design notes (why the kernel looks like this):
 The kernel is gated to Qmax <= PALLAS_MAX_QMAX (VMEM/SMEM budget); the
 windowed consensus path (the default) always fits.  Callers use
 ops/banded.select_aligner-style dispatch in consensus/star.py.
+
+Per-cell cost analysis (r5, after the slim with_stats=False carry):
+the remaining per-row tile-op budget splits ~24 ops select chain
+(diag/vert views of the H/E carry at per-problem shift d), ~21 ops
+F prefix scan (7 Hillis-Steele steps x roll+cmp+select), ~15 ops
+recurrence+moves.  The select chain is irreducible in this band-local
+lane layout: d differs per problem inside a G-block, so a scalar
+dynamic rotate cannot replace the per-candidate static shifts, and
+pre-shifting the carry at row end just moves the same chain.  The one
+known structural attack is a rotating-band layout (lane k holds
+column j === k mod B): vertical/diag predecessors become mask+static-
+rotate (~11 ops, no chain), but the F scan then needs per-step
+wrap masks (+14 ops) and the moves come out lane-rotated (one
+post-pass or projector index change) — net ~15% estimated, with real
+lowering risk.  Decision: hold that redesign until the slim kernel is
+timed on hardware (benchmarks/pallas_ab.py); if XLA's scan still wins
+after slim, the scan is the design and this kernel stays as the
+documented experiment (VERDICT r4 weak 3 protocol).
 """
 
 from __future__ import annotations

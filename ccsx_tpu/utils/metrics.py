@@ -35,6 +35,19 @@ class Metrics:
     # was asked for.  Pair alignments (PairExecutor) are included.
     dp_cells_real: int = 0
     dp_cells_padded: int = 0
+    # decomposition of the occupancy loss (consensus rounds only; pair
+    # alignments excluded): pass_fill = real pass-rows / (REAL hole
+    # slots x P) and z_fill = real holes / Z slots are independent
+    # factors, so for the round dispatches
+    #   dp_occupancy = length_fill x pass_fill x z_fill
+    # with length_fill derivable as occupancy / (pass_fill x z_fill) —
+    # bucket tuning can see WHICH bucket wastes.  (Cell counters also
+    # include pair alignments, so the identity is approximate when the
+    # prep stage dispatched pairs.)
+    dp_rows_real: int = 0
+    dp_rows_padded: int = 0
+    dp_holes_real: int = 0
+    dp_holes_padded: int = 0
     # compressed input bytes this process ingested (byte-range sharded
     # BAM ingest reports its ~1/N share; full-parse paths report the
     # file size).  0 when unknown (stdin / pure-stream inputs).
@@ -95,6 +108,12 @@ class Metrics:
             "dp_occupancy": round(self.dp_cells_real
                                   / self.dp_cells_padded, 4)
                             if self.dp_cells_padded else None,
+            "dp_pass_fill": round(self.dp_rows_real
+                                  / self.dp_rows_padded, 4)
+                            if self.dp_rows_padded else None,
+            "dp_z_fill": round(self.dp_holes_real
+                               / self.dp_holes_padded, 4)
+                         if self.dp_holes_padded else None,
             "ingest_bytes": self.ingest_bytes,
             "ingest_s": round(self.t_ingest, 6),
             "prep_s": round(self.t_prep, 6),

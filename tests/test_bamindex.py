@@ -129,3 +129,36 @@ def test_merge_refuses_mixed_modes(tmp_path):
         w.close()
     with pytest.raises(ValueError, match="sharding mode"):
         dist.merge_shards(str(tmp_path / "o.fa"), 2)
+
+
+def test_range_read_detects_corruption(tmp_path, rng):
+    """A bit-flipped BGZF block under a range read must raise BamError
+    (CRC check), not yield silently wrong records."""
+    p = tmp_path / "in.bam"
+    _write_bam(p, rng, n_holes=6, tlen=1500)
+    idx = bamindex.build_index(str(p), every=2)
+    data = bytearray(p.read_bytes())
+    data[len(data) // 2] ^= 0xFF        # flip a payload byte mid-file
+    p.write_bytes(bytes(data))
+    # the index fingerprint still matches (same size; mtime refreshed)
+    st = os.stat(p)
+    idx["mtime_ns"] = st.st_mtime_ns
+    with pytest.raises(bam.BamError):
+        for _ in bamindex.read_hole_range(str(p), idx, 0,
+                                          idx["n_holes"]):
+            pass
+
+
+def test_range_read_truncated_file(tmp_path, rng):
+    """Truncation mid-block under a range read raises, mirroring the
+    sequential reader's truncated-stream contract."""
+    p = tmp_path / "in.bam"
+    _write_bam(p, rng, n_holes=6, tlen=1500)
+    idx = bamindex.build_index(str(p), every=2)
+    data = p.read_bytes()
+    p.write_bytes(data[: len(data) - len(data) // 3])
+    idx["size"] = os.path.getsize(p)
+    with pytest.raises(bam.BamError):
+        for _ in bamindex.read_hole_range(str(p), idx, 0,
+                                          idx["n_holes"]):
+            pass

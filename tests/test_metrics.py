@@ -120,3 +120,27 @@ def test_batch_off_with_hosts_rejected(tmp_path):
     rc = cli.main(["-A", "--hosts", "2", "--host-id", "0",
                    "--batch", "off", "in.fa", "out.fa"])
     assert rc == 1
+
+
+def test_dp_occupancy_counters(tmp_path, rng):
+    """The batched run reports padding occupancy (SURVEY §7.3 item 2):
+    counters present, occupancy in (0, 1], and the factorization
+    occupancy ~= length_fill * pass_fill * z_fill holds (the length
+    factor is implied by the other three reported numbers)."""
+    import json
+
+    _, fa = _write_fasta(tmp_path, rng, n_holes=3)
+    out = tmp_path / "o.fa"
+    m = tmp_path / "m.jsonl"
+    assert cli.main(["-A", "-m", "1000", "--batch", "on",
+                     "--metrics", str(m), str(fa), str(out)]) == 0
+    fin = [json.loads(ln) for ln in m.read_text().splitlines()][-1]
+    assert fin["event"] == "final"
+    assert fin["dp_cells_padded"] >= fin["dp_cells_real"] > 0
+    assert 0 < fin["dp_occupancy"] <= 1
+    assert 0 < fin["dp_pass_fill"] <= 1
+    assert 0 < fin["dp_z_fill"] <= 1
+    # no factorization identity asserted: pair alignments contribute to
+    # the cell counters but not to the row/hole decomposition, so
+    # occupancy is not exactly length_fill * pass_fill * z_fill when
+    # prep dispatched pairs (as it does for these partial-end fixtures)

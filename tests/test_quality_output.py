@@ -239,3 +239,30 @@ def test_quality_drops_at_disputed_columns(rng):
     # unanimous 8-pass columns: 8 + 3*5 + 1*3 = 26 (qv_coeffs default,
     # knee at 5 supporters)
     assert quals[disputed - 1] == 26
+
+
+def test_apply_hp_penalty_final_assembly():
+    """The hp penalty runs on the FINAL assembled consensus: a run that
+    a window boundary would split must be penalized at its true length
+    (r5 code-review finding), and a 5-tuple (r4 coeffs) is a no-op."""
+    from ccsx_tpu.consensus.star import apply_hp_penalty
+
+    # AAAAA CG: run of 5 (capped at 4 units), then runs of 1
+    codes = np.array([0, 0, 0, 0, 0, 1, 2], np.uint8)
+    quals = np.full(7, 30, np.uint8)
+    coeffs = (8.0, 3.0, 6.0, 5, 1.0, 7.0, 4)
+    out = apply_hp_penalty(codes, quals, coeffs)
+    np.testing.assert_array_equal(out[:5], 30 - 28)   # 7*min(4,4)
+    np.testing.assert_array_equal(out[5:], 30)
+    # floor at 1
+    out2 = apply_hp_penalty(codes, np.full(7, 5, np.uint8), coeffs)
+    assert out2[:5].max() == 1
+    # r4-compatible 5-tuple: untouched
+    np.testing.assert_array_equal(
+        apply_hp_penalty(codes, quals, coeffs[:5]), quals)
+    # the regression shape: two chunks of the same run scored separately
+    # (2+3 split: 7*1 and 7*2) under-penalize vs the assembled run
+    split = np.concatenate([
+        apply_hp_penalty(codes[:2], quals[:2], coeffs),
+        apply_hp_penalty(codes[2:], quals[2:], coeffs)])
+    assert (split[:5] > out[:5]).all()
