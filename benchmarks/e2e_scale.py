@@ -134,6 +134,8 @@ def run_scale(n_holes: int, inflight: int, rng, device: str = "auto",
             "dp_cells_real": final["dp_cells_real"],
             "dp_cells_padded": final["dp_cells_padded"],
             "dp_occupancy": final["dp_occupancy"],
+            "dp_round_occupancy": final["dp_round_occupancy"],
+            "dp_length_fill": final["dp_length_fill"],
             "dp_pass_fill": final["dp_pass_fill"],
             "dp_z_fill": final["dp_z_fill"],
             "stage_seconds": {k: final[k] for k in

@@ -337,7 +337,6 @@ def main():
     resolve_device(a.device)
     import jax
 
-    rng = np.random.default_rng(7)
     from ccsx_tpu.config import CcsConfig
 
     res = {"backend": jax.default_backend(), "q20_definition":
@@ -346,7 +345,42 @@ def main():
            # pin the QV model the table was generated under, so the
            # calibration gate (tests/test_quality_output.py) can detect
            # a stale artifact after a coefficient change
-           "qv_coeffs": list(CcsConfig(is_bam=False).qv_coeffs)}
+           "qv_coeffs": list(CcsConfig(is_bam=False).qv_coeffs),
+           # pin the run parameters so a resumed run can verify the
+           # checkpoint came from the same configuration — including the
+           # error models, so editing ERR/ERR_BIASED invalidates a stale
+           # checkpoint instead of silently mixing old-model sections
+           # into an artifact that reports the new models
+           "holes": a.holes, "full": bool(a.full),
+           # json round-trip so the == check against a reloaded .partial
+           # compares like with like (tuples become lists)
+           "error_models": json.loads(json.dumps(
+               {"iid": ERR, "biased": ERR_BIASED}))}
+
+    # resume from a .partial checkpoint left by a crashed/timed-out run.
+    # Sound because every section below draws from its OWN seeded rng
+    # (no shared stream), so skipping completed sections reproduces the
+    # exact bytes a single uninterrupted run would have produced.
+    done = {}
+    if a.json and os.path.exists(a.json + ".partial"):
+        try:
+            with open(a.json + ".partial") as f:
+                prev = json.load(f)
+            if all(prev.get(k) == res[k] for k in
+                   ("backend", "qv_coeffs", "holes", "full",
+                    "error_models")):
+                done = prev
+                print(f"[quality] resuming from {a.json}.partial "
+                      f"(sections: {sorted(done)})", file=sys.stderr)
+            else:
+                bad = [k for k in ("backend", "qv_coeffs", "holes",
+                                   "full", "error_models")
+                       if prev.get(k) != res[k]]
+                print(f"[quality] IGNORING {a.json}.partial: mismatched "
+                      f"{bad} — recomputing all sections", file=sys.stderr)
+        except (OSError, ValueError):
+            pass
+
     def save():
         # checkpoint after every section: a timed-out run still leaves
         # the completed sections on disk (a full 100-hole run is >1h on
@@ -356,29 +390,36 @@ def main():
             with open(a.json + ".partial", "w") as f:
                 json.dump(res, f, indent=1)
 
-    res["error_models"] = {"iid": ERR, "biased": ERR_BIASED}
-    res["gate"] = []
-    for c in (1, 2, 3, 4, 5):
-        res["gate"].append(run_gate_config(c, a.holes, rng))
+    def section(key, fn):
+        res[key] = done[key] if key in done else fn()
         save()
+
+    # each gate config is its own checkpointed section with its own
+    # seed — the gate dominates the run (>1h at 100 holes on a 1-core
+    # host), so a crash mid-gate must only lose ONE config, not five
+    for c in (1, 2, 3, 4, 5):
+        section(f"gate_{c}", lambda c=c: run_gate_config(
+            c, a.holes, np.random.default_rng(100 + c)))
+    # assembled view (what schema consumers read); the gate_N sections
+    # stay in the artifact as the per-config resume checkpoints
+    res["gate"] = [res[f"gate_{c}"] for c in (1, 2, 3, 4, 5)]
+    save()
     # realistic correlated errors on the config-1 shape: the yield the
     # framework would report on homopolymer-heavy real data
-    res["gate_biased"] = run_gate_config(1, a.holes, rng, err=ERR_BIASED)
-    save()
-    res["sweep_max_window"] = sweep_max_window(
-        rng, n_holes=8 if a.full else 4)
-    save()
-    res["sweep_max_passes"] = sweep_max_passes(
-        rng, n_holes=6 if a.full else 3)
-    save()
+    section("gate_biased", lambda: run_gate_config(
+        1, a.holes, np.random.default_rng(11), err=ERR_BIASED))
+    section("sweep_max_window", lambda: sweep_max_window(
+        np.random.default_rng(13), n_holes=8 if a.full else 4))
+    section("sweep_max_passes", lambda: sweep_max_passes(
+        np.random.default_rng(17), n_holes=6 if a.full else 3))
     # primary gated table: the CORRELATED model (tests/
     # test_quality_output.py asserts monotone at 5-Q granularity);
     # i.i.d. table kept for continuity with the r3/r4 artifacts
-    res["quality_calibration"] = quality_calibration(
-        rng, n_holes=64 if a.full else 16, err=ERR_BIASED)
-    save()
-    res["quality_calibration_iid"] = quality_calibration(
-        rng, n_holes=64 if a.full else 16)
+    section("quality_calibration", lambda: quality_calibration(
+        np.random.default_rng(19), n_holes=64 if a.full else 16,
+        err=ERR_BIASED))
+    section("quality_calibration_iid", lambda: quality_calibration(
+        np.random.default_rng(23), n_holes=64 if a.full else 16))
     print(json.dumps(res, indent=1))
     if a.json:
         with open(a.json, "w") as f:

@@ -271,7 +271,7 @@ class RoundResult:
         assembled consensus, and the windowed path materializes one
         chunk at a time (a run spanning a window breakpoint would be
         split and under-penalized) — callers apply
-        ``apply_hp_penalty`` after assembly (run_rounds in windowed.py,
+        ``apply_hp_penalty`` after assembly (windowed_gen in windowed.py,
         consensus_gen below).  Here a
         base column's support s is nwin (passes voting the winning cell)
         out of ncov covering passes and d = ncov - s dissent; an
@@ -322,6 +322,9 @@ def apply_hp_penalty(codes: np.ndarray, quals: np.ndarray,
     NOT inside materialize_with_qual — so runs spanning window
     breakpoints are penalized at their true length; the whole-read and
     windowed paths therefore agree on quals for the same sequence.
+    The penalty applies to the already-qv_cap-clipped Q; with the
+    default coefficients raw Q maxes at 50 (s=32: 8 + 3*5 + 1*27) below
+    qv_cap=60, so pre- vs post-cap order is indistinguishable there.
     A 5-tuple qv_coeffs (r4 behavior) is a no-op."""
     per_hp, hp_cap = qv_coeffs[5:7] if len(qv_coeffs) > 5 else (0.0, 0)
     if not per_hp or not len(codes):

@@ -454,18 +454,20 @@ class BatchExecutor:
         if self.metrics is None:
             return
         band = self.cfg.align.band
-        self.metrics.dp_cells_padded += Z * P * qmax * band * iters
-        self.metrics.dp_cells_real += band * iters * int(
+        padded = Z * P * qmax * band * iters
+        real = band * iters * int(
             sum(int(reqs[i].qlens[reqs[i].row_mask].sum()) for i in idxs))
-        # rows are counted over REAL hole slots only, so the three
-        # factors are independent: pass_fill = real rows / (real holes
-        # x P), z_fill = real holes / Z, and the length factor is
-        # occupancy / (pass_fill x z_fill) — no double counting
-        self.metrics.dp_rows_padded += len(idxs) * P
-        self.metrics.dp_rows_real += int(
-            sum(int(reqs[i].row_mask.sum()) for i in idxs))
-        self.metrics.dp_holes_padded += Z
-        self.metrics.dp_holes_real += len(idxs)
+        self.metrics.dp_cells_padded += padded
+        self.metrics.dp_cells_real += real
+        # round-only counters, all in CELL units (x qmax x band x iters)
+        # so the length/pass/Z factorization is exact in aggregate
+        # across heterogeneous shape groups (metrics.py)
+        rows_real = int(sum(int(reqs[i].row_mask.sum()) for i in idxs))
+        scale = qmax * band * iters
+        self.metrics.dp_round_cells_padded += padded
+        self.metrics.dp_round_cells_real += real
+        self.metrics.dp_rowcells_real += rows_real * scale
+        self.metrics.dp_rowcells_cap += len(idxs) * P * scale
 
     def _stack_group(self, reqs, idxs, P, qmax, tmax):
         """Pad + stack a shape group's requests into device inputs."""

@@ -35,19 +35,23 @@ class Metrics:
     # was asked for.  Pair alignments (PairExecutor) are included.
     dp_cells_real: int = 0
     dp_cells_padded: int = 0
-    # decomposition of the occupancy loss (consensus rounds only; pair
-    # alignments excluded): pass_fill = real pass-rows / (REAL hole
-    # slots x P) and z_fill = real holes / Z slots are independent
-    # factors, so for the round dispatches
-    #   dp_occupancy = length_fill x pass_fill x z_fill
-    # with length_fill derivable as occupancy / (pass_fill x z_fill) —
-    # bucket tuning can see WHICH bucket wastes.  (Cell counters also
-    # include pair alignments, so the identity is approximate when the
-    # prep stage dispatched pairs.)
-    dp_rows_real: int = 0
-    dp_rows_padded: int = 0
-    dp_holes_real: int = 0
-    dp_holes_padded: int = 0
+    # decomposition of the occupancy loss for the CONSENSUS-ROUND
+    # dispatches (pair alignments excluded — they have no Z/P bucket
+    # structure).  All four counters are in CELL units so the identity
+    #   round_real/round_padded = length_fill x pass_fill x z_fill
+    # holds EXACTLY even when dispatches with different (Z, P, qmax,
+    # iters) aggregate (unweighted row/hole ratios misattribute padding
+    # across heterogeneous shape groups):
+    #   length_fill = round_cells_real / rowcells_real
+    #   pass_fill   = rowcells_real   / rowcells_cap
+    #   z_fill      = rowcells_cap    / round_cells_padded
+    # where rowcells_real = real pass-rows at full qmax and
+    # rowcells_cap = (real holes x P) rows at full qmax, both
+    # x band x iters — bucket tuning can see WHICH bucket wastes.
+    dp_round_cells_real: int = 0
+    dp_round_cells_padded: int = 0
+    dp_rowcells_real: int = 0
+    dp_rowcells_cap: int = 0
     # compressed input bytes this process ingested (byte-range sharded
     # BAM ingest reports its ~1/N share; full-parse paths report the
     # file size).  0 when unknown (stdin / pure-stream inputs).
@@ -108,12 +112,18 @@ class Metrics:
             "dp_occupancy": round(self.dp_cells_real
                                   / self.dp_cells_padded, 4)
                             if self.dp_cells_padded else None,
-            "dp_pass_fill": round(self.dp_rows_real
-                                  / self.dp_rows_padded, 4)
-                            if self.dp_rows_padded else None,
-            "dp_z_fill": round(self.dp_holes_real
-                               / self.dp_holes_padded, 4)
-                         if self.dp_holes_padded else None,
+            "dp_round_occupancy": round(self.dp_round_cells_real
+                                        / self.dp_round_cells_padded, 4)
+                                  if self.dp_round_cells_padded else None,
+            "dp_length_fill": round(self.dp_round_cells_real
+                                    / self.dp_rowcells_real, 4)
+                              if self.dp_rowcells_real else None,
+            "dp_pass_fill": round(self.dp_rowcells_real
+                                  / self.dp_rowcells_cap, 4)
+                            if self.dp_rowcells_cap else None,
+            "dp_z_fill": round(self.dp_rowcells_cap
+                               / self.dp_round_cells_padded, 4)
+                         if self.dp_round_cells_padded else None,
             "ingest_bytes": self.ingest_bytes,
             "ingest_s": round(self.t_ingest, 6),
             "prep_s": round(self.t_prep, 6),

@@ -124,9 +124,11 @@ def test_batch_off_with_hosts_rejected(tmp_path):
 
 def test_dp_occupancy_counters(tmp_path, rng):
     """The batched run reports padding occupancy (SURVEY §7.3 item 2):
-    counters present, occupancy in (0, 1], and the factorization
-    occupancy ~= length_fill * pass_fill * z_fill holds (the length
-    factor is implied by the other three reported numbers)."""
+    counters present, occupancy in (0, 1], and — because all four
+    round-only counters are in cell units — the factorization
+    round_occupancy == length_fill * pass_fill * z_fill holds EXACTLY
+    (up to the 4-digit rounding of the reported fields), even across
+    heterogeneous shape-group dispatches."""
     import json
 
     _, fa = _write_fasta(tmp_path, rng, n_holes=3)
@@ -138,9 +140,13 @@ def test_dp_occupancy_counters(tmp_path, rng):
     assert fin["event"] == "final"
     assert fin["dp_cells_padded"] >= fin["dp_cells_real"] > 0
     assert 0 < fin["dp_occupancy"] <= 1
+    assert 0 < fin["dp_round_occupancy"] <= 1
+    assert 0 < fin["dp_length_fill"] <= 1
     assert 0 < fin["dp_pass_fill"] <= 1
     assert 0 < fin["dp_z_fill"] <= 1
-    # no factorization identity asserted: pair alignments contribute to
-    # the cell counters but not to the row/hole decomposition, so
-    # occupancy is not exactly length_fill * pass_fill * z_fill when
-    # prep dispatched pairs (as it does for these partial-end fixtures)
+    prod = (fin["dp_length_fill"] * fin["dp_pass_fill"]
+            * fin["dp_z_fill"])
+    assert abs(prod - fin["dp_round_occupancy"]) < 2e-3, (
+        prod, fin["dp_round_occupancy"])
+    # overall occupancy additionally includes PairExecutor cells, which
+    # have no Z/P bucket structure and are excluded from the factors
