@@ -26,7 +26,7 @@ import time
 
 # benchmark shapes (kept canonical so compiles cache): Z zmws x P passes x W window
 Z, P, W, TLEN = 16, 8, 1024, 1000
-WARMUP, ITERS, WINDOWS = 2, 25, 8
+ITERS, WINDOWS = 25, 8
 BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_baseline.json")
 
@@ -50,8 +50,7 @@ def measure():
     # CCSX_BANDED_IMPL=pallas selects the kernel for A/B runs
     aligner = star._aligner(params)
 
-    @jax.jit
-    def step(qs, qlens, ts, tlens, row_mask):
+    def round_core(qs, qlens, ts, tlens, row_mask):
         Zb, Pb, qmax = qs.shape
         ts_b = jax.numpy.broadcast_to(ts[:, None, :], (Zb, Pb, ts.shape[-1]))
         tl_b = jax.numpy.broadcast_to(tlens[:, None], (Zb, Pb))
@@ -67,33 +66,27 @@ def measure():
             aligned, ins_cnt, ins_b, row_mask)
         return cons, ncov
 
-    # resident inputs + async dispatch: ITERS dispatches are queued
-    # back-to-back and synchronized ONCE per window — the same shape the
-    # production scheduler has (pipeline/batch.py dispatches every shape
-    # group before materializing any result), and the standard JAX
-    # steady-state timing discipline.  Blocking every iteration instead
-    # measures the host<->device round-trip latency (~0.9 ms through the
-    # axon tunnel), not sustainable device throughput: measured r5,
-    # per-iter blocking reads 129-143k zmw-windows/s while the fused
-    # round itself takes 27 us on-device (benchmarks/round_profile_r05).
+    # Forced-execution marginal timing — the ONE method all benches
+    # share (full rationale in benchmarks/marginal_time.py: the lazy
+    # axon runtime neither waits in block_until_ready nor executes
+    # unfetched dispatches, so r2-r4's blocking loops measured the
+    # ~0.7-1 ms RPC ping and dispatch-queue timing measures
+    # bookkeeping).  The trade: no cross-round overlap is counted — a
+    # round is itself a (Z*P)-problem batch, so the chip is already
+    # saturated within one round.
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    from marginal_time import marginal_time
+
     args = [jax.device_put(a) for a in
             ge._example_batch(Z=Z, P=P, W=W, tlen=TLEN)]
-    for _ in range(WARMUP):
-        jax.block_until_ready(step(*args))
-    # the dev chip is shared/tunnelled and its available throughput
-    # drifts minute-to-minute; take the best of several short windows —
-    # the least externally-contaminated estimate of hardware capability
-    best = 0.0
-    for _ in range(WINDOWS):
-        t0 = time.perf_counter()
-        out = None
-        for _ in range(ITERS):
-            out = step(*args)
-        jax.block_until_ready(out)
-        dt = (time.perf_counter() - t0) / ITERS
-        best = max(best, Z / dt)
-        time.sleep(0.2)
-    return best  # ZMW-windows per second
+    # on an accelerator a round is sub-ms: raise the loop count so the
+    # marginal (iters-1) x round signal clears the +-ms jitter of the
+    # two checksum fetches (CPU rounds are ~0.5 s; ITERS=25 is plenty)
+    iters = ITERS if jax.default_backend() == "cpu" else 200
+    runs = marginal_time(round_core, *args, iters=iters,
+                         repeats=WINDOWS, settle=0.2)
+    return Z / min(runs)  # best window, ZMW-windows per second
 
 
 def main():

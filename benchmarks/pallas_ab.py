@@ -21,11 +21,13 @@ import argparse
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from marginal_time import marginal_time as _marginal_time  # noqa: E402
 
 
 def _bench_args(Z, P, W, tlen, seed=0):
@@ -104,7 +106,7 @@ def _round_step(impl: str, W: int):
     voter = msa.make_voter(4)
     # NOTE: the impl dispatch happens at TRACE time (star._aligner reads
     # use_pallas() when the jitted step first runs).  The caller
-    # (time_impl) holds the CCSX_BANDED_IMPL override through its warmup,
+    # (time_impl) holds the CCSX_BANDED_IMPL override through trace/compile,
     # which is when tracing occurs — do not call the returned step
     # outside such a scope or the wrong impl gets traced and cached.
     aligner = star._aligner(params)
@@ -132,30 +134,22 @@ def _round_step(impl: str, W: int):
     return step
 
 
-def time_impl(impl: str, Z, P, W, tlen, warmup=5, iters=100, repeats=3):
+def time_impl(impl: str, Z, P, W, tlen, iters=100, repeats=3):
     """Time one full consensus round step with the given banded impl.
 
-    Compiles once (cached across calls), then takes `repeats` timing
-    windows of `iters` dispatches each; returns zmw_windows/s per
-    window.  The CCSX_BANDED_IMPL override is held (try/finally) through
-    warmup — where the jitted step traces and the impl dispatch actually
-    happens — so a failure can't leak it into the process."""
-    import jax
-
+    Uses the forced-execution marginal method (_marginal_time — the r5
+    first-cut artifact pallas_ab_tpu_r05.json predates it and its
+    round/fill numbers are RPC-latency readings, not chip time); returns
+    zmw_windows/s per window.  The CCSX_BANDED_IMPL override is held
+    (try/finally) through trace/compile so a failure can't leak it into
+    the process."""
     prior = os.environ.get("CCSX_BANDED_IMPL")
     os.environ["CCSX_BANDED_IMPL"] = impl
     try:
         step = _round_step(impl, W)
         args = _bench_args(Z, P, W, tlen)
-        for _ in range(warmup):
-            jax.block_until_ready(step(*args))
-        runs = []
-        for _ in range(repeats):
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                jax.block_until_ready(step(*args))
-            dt = (time.perf_counter() - t0) / iters
-            runs.append(Z / dt)
+        runs = [Z / dt for dt in _marginal_time(
+            step, *args, iters=iters, repeats=repeats)]
     finally:
         if prior is None:
             os.environ.pop("CCSX_BANDED_IMPL", None)
@@ -164,7 +158,7 @@ def time_impl(impl: str, Z, P, W, tlen, warmup=5, iters=100, repeats=3):
     return runs
 
 
-def time_fill_only(impl: str, Z, P, W, tlen, warmup=5, iters=300,
+def time_fill_only(impl: str, Z, P, W, tlen, iters=300,
                    repeats=3):
     """Time just the DP fill (no projection/vote) — isolates the kernel.
 
@@ -209,19 +203,12 @@ def time_fill_only(impl: str, Z, P, W, tlen, warmup=5, iters=300,
         np.broadcast_to(ts[:, None, :], (Z, P, ts.shape[-1]))).reshape(n, -1)
     tlens_f = np.ascontiguousarray(
         np.broadcast_to(tlens[:, None], (Z, P))).reshape(n)
-    for _ in range(warmup):
-        jax.block_until_ready(fill(qs_f, qlens_f, ts_f, tlens_f))
     cells = n * W * band
-    runs = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            jax.block_until_ready(fill(qs_f, qlens_f, ts_f, tlens_f))
-        dt = (time.perf_counter() - t0) / iters
-        runs.append({"zmw_windows_per_sec": Z / dt,
-                     "dp_cells_per_sec": cells / dt,
-                     "ms_per_dispatch": dt * 1e3})
-    return runs
+    return [{"zmw_windows_per_sec": Z / dt,
+             "dp_cells_per_sec": cells / dt,
+             "ms_per_dispatch": dt * 1e3}
+            for dt in _marginal_time(fill, qs_f, qlens_f, ts_f, tlens_f,
+                                     iters=iters, repeats=repeats)]
 
 
 def main():

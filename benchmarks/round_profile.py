@@ -6,9 +6,14 @@ measurement, two ways:
 
   1. staged timing — the round's three stages (banded DP fill,
      traceback projection, column vote) are jitted and timed SEPARATELY
-     on device (block_until_ready, best-of-windows like bench.py), plus
-     the fused full round.  The deltas attribute round time to stages
-     and quantify what XLA's fusion of the full round buys.
+     on device, plus the fused full round.  The deltas attribute round
+     time to stages and quantify what XLA's fusion of the full round
+     buys.  Timing uses the forced-execution marginal method (see
+     _time): the r5 discovery that ``block_until_ready`` does NOT wait
+     on the axon runtime invalidated the original block-per-window
+     loop — the r5 first-cut artifacts (round_profile_r05*.json,
+     "fused_full_round": 27us) measured dispatch bookkeeping, not the
+     chip.
   2. a ``jax.profiler`` trace of the warm full round is written to
      --trace-dir for op-level inspection (the artifact the roofline
      claim can be checked against).
@@ -30,23 +35,15 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
 Z, P, W, TLEN = 16, 8, 1024, 1000   # bench.py's canonical round shapes
-WARMUP, ITERS, WINDOWS = 2, 20, 6
+ITERS, WINDOWS = 20, 6   # ITERS raised on TPU in main() (signal >> d2h jitter)
 
 
 def _time(fn, *args):
-    import jax
+    """Best-window marginal seconds per fn(*args) call (the shared
+    forced-execution method — full rationale in marginal_time.py)."""
+    from marginal_time import marginal_time
 
-    for _ in range(WARMUP):
-        jax.block_until_ready(fn(*args))
-    best = float("inf")
-    for _ in range(WINDOWS):
-        t0 = time.perf_counter()
-        for _ in range(ITERS):
-            out = fn(*args)
-        jax.block_until_ready(out)
-        best = min(best, (time.perf_counter() - t0) / ITERS)
-        time.sleep(0.1)
-    return best
+    return min(marginal_time(fn, *args, iters=ITERS, repeats=WINDOWS))
 
 
 def main():
@@ -63,6 +60,13 @@ def main():
     resolve_device(a.device)
     import jax
     import jax.numpy as jnp
+
+    # on TPU the stages are ~0.1-1 ms: raise ITERS so the marginal
+    # (ITERS-1) x stage time dominates the +-ms jitter of the two
+    # checksum fetches.  CPU stages are ~0.1-0.5 s; 20 is plenty.
+    global ITERS
+    if jax.default_backend() != "cpu":
+        ITERS = 200
 
     from ccsx_tpu.config import AlignParams
     from ccsx_tpu.consensus import star
@@ -132,8 +136,11 @@ def main():
     if a.trace_dir:
         with jax.profiler.trace(a.trace_dir):
             for _ in range(5):
-                jax.block_until_ready(full(qs3, ql3, jnp.asarray(ts),
-                                           tl_r, rm))
+                # np.asarray, not block_until_ready: the fetch is the
+                # only op that provably forces execution inside the
+                # trace window on the lazy axon runtime
+                np.asarray(full(qs3, ql3, jnp.asarray(ts),
+                                tl_r, rm)[0])
 
     cells = Z * P * W * 128
     res = {

@@ -1,13 +1,18 @@
 #!/bin/sh
 # Background tunnel watcher: probe the tunnelled TPU with a real jitted
-# dispatch (enumeration alone can succeed while dispatch hangs) every
-# ~3 minutes; exit 0 the moment the chip answers so the caller can run
-# benchmarks/tpu_battery.sh while the window is open.
+# dispatch AND a byte materialization (block_until_ready does not wait
+# on the lazy axon runtime — memory/axon notes; enumeration alone can
+# succeed while dispatch hangs) every ~3 minutes; exit 0 the moment the
+# chip answers so the caller can run benchmarks/tpu_battery.sh while
+# the window is open.
 LOG=${1:-/tmp/tunnel_watch.log}
 : > "$LOG"
 while true; do
     ts=$(date -u +%H:%M:%S)
-    if timeout 90 python -c "import jax, numpy; jax.block_until_ready(jax.jit(lambda a: a + 1)(numpy.ones(8))); assert jax.devices()[0].platform != 'cpu'" 2>>"$LOG"; then
+    if timeout 90 python -c "
+import jax, numpy
+v = numpy.asarray(jax.jit(lambda a: a + 1)(numpy.ones(8)))
+assert v[0] == 2 and jax.devices()[0].platform != 'cpu'" 2>>"$LOG"; then
         echo "$ts TUNNEL ALIVE" >> "$LOG"
         exit 0
     fi

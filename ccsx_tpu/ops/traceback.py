@@ -57,12 +57,14 @@ def make_projector(tmax: int, max_ins: int = 4):
     Dispatches between the two bit-identical implementations:
     ``CCSX_PROJECTOR=scan|walk`` forces one; default is the cell walk.
     Measured on XLA:CPU the walk's in-loop scatters are cheap and the
-    scan's extra gathers lose (0.31s vs 0.48s at the bench shapes); the
-    scan halves the sequential depth, which is what should matter on the
-    accelerator, but it is UNMEASURED on TPU — A/B with
-    benchmarks/round_profile.py (CCSX_PROJECTOR=scan) and flip the
-    default here if it wins.  Until then the walk default also keeps the
-    persistent compile cache for the production round programs valid."""
+    scan's extra gathers lose (0.31s vs 0.48s at the bench shapes).
+    The r5 first-cut TPU A/B (round_profile_r05{,_scanproj}.json,
+    2026-07-31) read a projection-stage dead heat but was taken with
+    the blocking loop the lazy axon runtime turns into RPC-latency
+    readings (bench.py docstring) — it is not evidence either way.
+    The corrected profiler (forced-execution marginal timing) decides
+    this at its next hardware run; until a measurement favors the scan
+    the walk stays the default on every backend."""
     import os
 
     impl = os.environ.get("CCSX_PROJECTOR", "")

@@ -88,7 +88,7 @@ def _round_body(params: AlignParams, max_ins: int, tmax: int):
 
 @functools.lru_cache(maxsize=128)
 def _round_step(params: AlignParams, max_ins: int, tmax: int,
-                bp_consts: tuple):
+                bp_consts: tuple, pack: tuple | None = None):
     """Jitted batched star round: (Z, P, qmax) passes vs (Z, tmax) drafts.
 
     Z/P/qmax shape specialization is left to jit's trace cache; tmax,
@@ -96,14 +96,25 @@ def _round_step(params: AlignParams, max_ins: int, tmax: int,
     the cache here.  The breakpoint scan + cursor advance run on-device
     (ops/breakpoint.py), so only small per-hole outputs cross to the
     host — not the (Z, P, tmax) match/aligned/ins_cnt tensors.
-    """
+
+    pack=(P, qmax) selects the TRANSFER-PACKED variant for single-device
+    runs: inputs arrive as ONE (Z, P*qmax + tmax) uint8 buffer + ONE
+    (Z, 2P+1) int32 buffer and outputs leave as one uint8 + one int32
+    buffer (see _pack_args/_unpack_round).  Host<->device transfer cost
+    is dominated by a fixed per-transfer latency, not bandwidth
+    (measured r5: ~30-100 ms per transfer through the axon tunnel vs
+    ~70 MB/s streaming; on real PCIe the same fixed DMA/launch overhead
+    applies at smaller scale), so 5 h2d + 7 d2h per dispatch costs ~12
+    latencies where 2 + 2 cost 4.  The multi-device path keeps separate
+    arrays — they carry per-argument NamedShardings (_shard_args)."""
+    import jax.numpy as jnp
+
     from ccsx_tpu.ops import breakpoint as bp_mod
 
     body = _round_body(params, max_ins, tmax)
     bp_advance = bp_mod.make_bp_advance(tmax, *bp_consts)
 
-    @jax.jit
-    def step(qs, qlens, ts, tlens, row_mask):
+    def core(qs, qlens, ts, tlens, row_mask):
         (cons, ins_base, ins_votes, ncov, nwin, match, aligned, ins_cnt,
          lead_ins) = body(qs, qlens, row_mask, ts, tlens)
         bp, advance = jax.vmap(bp_advance)(
@@ -112,11 +123,72 @@ def _round_step(params: AlignParams, max_ins: int, tmax: int,
         # count (<= 64 with the largest pass bucket), so uint8 halves the
         # transfer; the host casts back before arithmetic
         # (msa.emit_insertions)
-        return (cons, ins_base, ins_votes.astype(jax.numpy.uint8),
-                ncov.astype(jax.numpy.uint8),
-                nwin.astype(jax.numpy.uint8), bp, advance)
+        return (cons, ins_base, ins_votes.astype(jnp.uint8),
+                ncov.astype(jnp.uint8),
+                nwin.astype(jnp.uint8), bp, advance)
+
+    if pack is None:
+        return jax.jit(core)
+    P, qmax = pack
+
+    @jax.jit
+    def step(big, small):
+        qs, qlens, ts, tlens, row_mask = _unpack_args_jax(
+            big, small, P, qmax, tmax)
+        cons, ins_base, ins_votes, ncov, nwin, bp, advance = core(
+            qs, qlens, ts, tlens, row_mask)
+        Z = big.shape[0]
+        big_out = jnp.concatenate([
+            cons.astype(jnp.uint8),
+            ins_base.reshape(Z, tmax * max_ins).astype(jnp.uint8),
+            ins_votes.reshape(Z, tmax * max_ins),
+            ncov, nwin], axis=1)
+        small_out = jnp.concatenate(
+            [bp[:, None], advance], axis=1).astype(jnp.int32)
+        return big_out, small_out
 
     return step
+
+
+def _pack_args(args):
+    """Host side of the packed single-device transfer protocol: the 5
+    round/refine inputs become one uint8 and one int32 buffer (one h2d
+    latency each instead of five)."""
+    qs, qlens, ts, tlens, row_mask = args
+    Z, P, qmax = qs.shape
+    big = np.concatenate([qs.reshape(Z, P * qmax), ts], axis=1)
+    small = np.concatenate(
+        [qlens, tlens[:, None], row_mask.astype(np.int32)], axis=1)
+    return big, small
+
+
+def _unpack_args_jax(big, small, P: int, qmax: int, tmax: int):
+    """Device side of _pack_args (slices compile to views/copies that
+    cost nothing next to the transfer latencies they replace)."""
+    Z = big.shape[0]
+    qs = big[:, :P * qmax].reshape(Z, P, qmax)
+    ts = big[:, P * qmax:P * qmax + tmax]
+    qlens = small[:, :P]
+    tlens = small[:, P]
+    row_mask = small[:, P + 1:2 * P + 1] != 0
+    return qs, qlens, ts, tlens, row_mask
+
+
+def _unpack_round(big, small, max_ins: int, tmax: int):
+    """Host-side split of a packed round result back into the 7-tuple
+    (cons, ins_base, ins_votes, ncov, nwin, bp, advance) with the same
+    dtypes the unpacked path ships."""
+    Z = big.shape[0]
+    R = max_ins
+    cons = big[:, :tmax]
+    ins_base = big[:, tmax:tmax * (1 + R)].reshape(Z, tmax, R)
+    ins_votes = big[:, tmax * (1 + R):tmax * (1 + 2 * R)].reshape(
+        Z, tmax, R)
+    ncov = big[:, tmax * (1 + 2 * R):tmax * (2 + 2 * R)]
+    nwin = big[:, tmax * (2 + 2 * R):tmax * (3 + 2 * R)]
+    bp = small[:, 0]
+    advance = small[:, 1:]
+    return cons, ins_base, ins_votes, ncov, nwin, bp, advance
 
 
 def _z_bucket(n: int) -> int:
@@ -139,8 +211,12 @@ def _fused_tmax(tlen: int, quant: int) -> int:
 
 @functools.lru_cache(maxsize=128)
 def _refine_step(params: AlignParams, max_ins: int, tmax: int, iters: int,
-                 bp_consts: tuple):
+                 bp_consts: tuple, pack: tuple | None = None):
     """ONE jitted dispatch for a window's whole refinement loop.
+
+    pack=(P, qmax) selects the transfer-packed single-device variant
+    (same protocol and rationale as _round_step; small_out additionally
+    carries dlen and ovf).
 
     Runs `iters` speculative star rounds in a device while_loop —
     realign to draft, vote, emit insertions liberally, re-materialize
@@ -166,8 +242,7 @@ def _refine_step(params: AlignParams, max_ins: int, tmax: int, iters: int,
     spec_emit = jax.vmap(
         lambda ib, iv, nc: msa_mod.emit_insertions_jax(ib, iv, nc, True))
 
-    @jax.jit
-    def step(qs, qlens, ts, tlens, row_mask):
+    def core(qs, qlens, ts, tlens, row_mask):
         Z, P, _ = qs.shape
 
         def body(carry):
@@ -244,7 +319,36 @@ def _refine_step(params: AlignParams, max_ins: int, tmax: int, iters: int,
                 ncov.astype(jnp.uint8), nwin.astype(jnp.uint8),
                 bp, advance, dlen, ovf)
 
+    if pack is None:
+        return jax.jit(core)
+    P, qmax = pack
+
+    @jax.jit
+    def step(big, small):
+        args = _unpack_args_jax(big, small, P, qmax, tmax)
+        (cons, ins_base, ins_votes, ncov, nwin, bp, advance, dlen,
+         ovf) = core(*args)
+        Z = big.shape[0]
+        big_out = jnp.concatenate([
+            cons.astype(jnp.uint8),
+            ins_base.reshape(Z, tmax * max_ins).astype(jnp.uint8),
+            ins_votes.reshape(Z, tmax * max_ins),
+            ncov, nwin], axis=1)
+        small_out = jnp.concatenate(
+            [bp[:, None], advance, dlen[:, None],
+             ovf[:, None].astype(jnp.int32)], axis=1).astype(jnp.int32)
+        return big_out, small_out
+
     return step
+
+
+def _unpack_refine(big, small, max_ins: int, tmax: int):
+    """Host-side split of a packed refine result back into the 9-tuple
+    (cons, ins_base, ins_votes, ncov, nwin, bp, advance, dlen, ovf)."""
+    cons, ins_base, ins_votes, ncov, nwin, bp, rest = _unpack_round(
+        big, small, max_ins, tmax)
+    return (cons, ins_base, ins_votes, ncov, nwin, bp, rest[:, :-2],
+            rest[:, -2], rest[:, -1] != 0)
 
 
 @functools.lru_cache(maxsize=8)
@@ -254,6 +358,29 @@ def _pair_fill(params: AlignParams):
     from ccsx_tpu.ops import banded as banded_mod
 
     return banded_mod.make_batched("local", params, with_line=True)
+
+
+@functools.lru_cache(maxsize=32)
+def _pair_fill_packed(params: AlignParams, qmax: int, tmax: int):
+    """Transfer-packed pair fill: one (N, qmax+tmax) uint8 + one (N, 6)
+    int32 in, one (N, 7) int32 out — 3 transfer latencies per dispatch
+    instead of 12 (5 h2d + 7 scalar-array d2h; the per-transfer latency
+    dominates at these sizes, see _round_step)."""
+    import jax.numpy as jnp
+
+    fill = _pair_fill(params)
+
+    @jax.jit
+    def step(big, small):
+        qs = big[:, :qmax]
+        ts = big[:, qmax:qmax + tmax]
+        qlens, tlens, ls = small[:, 0], small[:, 1], small[:, 2:6]
+        r = fill(qs, qlens, ts, tlens, ls)
+        return jnp.stack(
+            [r.score, r.qb, r.qe, r.tb, r.te, r.aln, r.mat],
+            axis=1).astype(jnp.int32)
+
+    return step
 
 
 class PairExecutor:
@@ -298,39 +425,35 @@ class PairExecutor:
         if self.metrics is not None:
             self.metrics.pair_alignments += len(lines)
             self.metrics.device_dispatches += len(groups)
-        fill = _pair_fill(self.params)
         pending = []
         for (qmax, tmax), idxs in groups.items():
             N = _z_bucket(len(idxs))
-            qs = np.stack([pad_to(pairs[i].q, qmax) for i in idxs]
-                          + [pad_to(np.zeros(0, np.uint8), qmax)]
-                          * (N - len(idxs)))
-            ts = np.stack([pad_to(pairs[i].t, tmax) for i in idxs]
-                          + [pad_to(np.zeros(0, np.uint8), tmax)]
-                          * (N - len(idxs)))
-            qlens = np.zeros((N,), np.int32)
-            tlens = np.zeros((N,), np.int32)
-            ls = np.zeros((N, 4), np.int32)
+            # PAD-filled so the dummy tail slots look exactly like the
+            # old pad_to(empty) rows (qlen/tlen stay 0 in `small`)
+            big = np.full((N, qmax + tmax), banded.PAD, np.uint8)
+            small = np.zeros((N, 6), np.int32)
             for z, i in enumerate(idxs):
-                qlens[z] = len(pairs[i].q)
-                tlens[z] = len(pairs[i].t)
-                ls[z] = lines[i]
+                big[z, :qmax] = pad_to(pairs[i].q, qmax)
+                big[z, qmax:] = pad_to(pairs[i].t, tmax)
+                small[z, 0] = len(pairs[i].q)
+                small[z, 1] = len(pairs[i].t)
+                small[z, 2:6] = lines[i]
             if self.metrics is not None:
                 self.metrics.dp_cells_padded += N * qmax * self.params.band
-                self.metrics.dp_cells_real += (int(qlens.sum())
+                self.metrics.dp_cells_real += (int(small[:, 0].sum())
                                                * self.params.band)
             # async-dispatch every bucket before reading any back
-            pending.append((idxs, fill(qs, qlens, ts, tlens, ls)))
+            step = _pair_fill_packed(self.params, qmax, tmax)
+            pending.append((idxs, step(big, small)))
         for idxs, res in pending:
-            score = np.asarray(res.score)
-            qb, qe = np.asarray(res.qb), np.asarray(res.qe)
-            tb, te = np.asarray(res.tb), np.asarray(res.te)
-            aln, mat = np.asarray(res.aln), np.asarray(res.mat)
+            res = np.asarray(res)
             for z, i in enumerate(idxs):
+                score, qb, qe, tb, te, aln, mat = (
+                    int(v) for v in res[z])
                 rs = MatchResult(
-                    ok=False, score=int(score[z]), qb=int(qb[z]),
-                    qe=int(qe[z]), tb=int(tb[z]), te=int(te[z]),
-                    aln=int(aln[z]), mat=int(mat[z]))
+                    ok=False, score=score, qb=qb,
+                    qe=qe, tb=tb, te=te,
+                    aln=aln, mat=mat)
                 pr = pairs[i]
                 # acceptance rule, main.c:280
                 rs.ok = (rs.aln * 2 > min(len(pr.q), len(pr.t))) and (
@@ -528,12 +651,24 @@ class BatchExecutor:
         for (P, qmax, tmax), idxs in groups.items():
             args = self._stack_group(requests, idxs, P, qmax, tmax)
             self._count_cells(requests, idxs, P, qmax, args[0].shape[0])
-            step = _round_step(cfg.align, cfg.max_ins_per_col, tmax,
-                               self._bp_consts())
-            pending.append((idxs, step(*self._shard_args(args, P))))
-        for idxs, out in pending:
-            (cons, ins_base, ins_votes, ncov, nwin, bp, advance) = (
-                np.asarray(o) for o in out)
+            if self._mesh is None:
+                # packed single-device transfers, as in _run_refine
+                step = _round_step(cfg.align, cfg.max_ins_per_col, tmax,
+                                   self._bp_consts(), pack=(P, qmax))
+                pending.append((idxs, tmax, step(*_pack_args(args))))
+            else:
+                step = _round_step(cfg.align, cfg.max_ins_per_col, tmax,
+                                   self._bp_consts())
+                pending.append(
+                    (idxs, tmax, step(*self._shard_args(args, P))))
+        for idxs, tmax, out in pending:
+            out = tuple(np.asarray(o) for o in out)
+            if self._mesh is None:
+                (cons, ins_base, ins_votes, ncov, nwin, bp,
+                 advance) = _unpack_round(
+                    out[0], out[1], cfg.max_ins_per_col, tmax)
+            else:
+                (cons, ins_base, ins_votes, ncov, nwin, bp, advance) = out
             for z, i in enumerate(idxs):
                 results[i] = RoundResult(
                     cons=cons[z], ins_base=ins_base[z],
@@ -566,12 +701,27 @@ class BatchExecutor:
             args = self._stack_group(requests, idxs, P, qmax, tmax)
             self._count_cells(requests, idxs, P, qmax, args[0].shape[0],
                               iters)
-            step = _refine_step(cfg.align, cfg.max_ins_per_col, tmax,
-                                iters, self._bp_consts())
-            pending.append((idxs, step(*self._shard_args(args, P))))
-        for idxs, out in pending:
-            (cons, ins_base, ins_votes, ncov, nwin, bp, advance, dlen,
-             ovf) = (np.asarray(o) for o in out)
+            if self._mesh is None:
+                # single device: packed transfer protocol (2 h2d + 2 d2h
+                # latencies per dispatch instead of 5 + 9)
+                step = _refine_step(cfg.align, cfg.max_ins_per_col, tmax,
+                                    iters, self._bp_consts(),
+                                    pack=(P, qmax))
+                pending.append((idxs, tmax, step(*_pack_args(args))))
+            else:
+                step = _refine_step(cfg.align, cfg.max_ins_per_col, tmax,
+                                    iters, self._bp_consts())
+                pending.append(
+                    (idxs, tmax, step(*self._shard_args(args, P))))
+        for idxs, tmax, out in pending:
+            out = tuple(np.asarray(o) for o in out)
+            if self._mesh is None:
+                (cons, ins_base, ins_votes, ncov, nwin, bp, advance,
+                 dlen, ovf) = _unpack_refine(
+                    out[0], out[1], cfg.max_ins_per_col, tmax)
+            else:
+                (cons, ins_base, ins_votes, ncov, nwin, bp, advance,
+                 dlen, ovf) = out
             for z, i in enumerate(idxs):
                 req = requests[i]
                 if ovf[z]:

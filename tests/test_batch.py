@@ -263,3 +263,44 @@ def test_cli_mesh_too_large_clean_error(tmp_path, rng, capsys):
     assert rc == 1
     assert "invalid --mesh" in capsys.readouterr().err
     assert out.read_text() == "precious\n"
+
+
+def test_packed_transfer_protocol_matches_unpacked(rng):
+    """The packed single-device transfer protocol (one uint8 + one int32
+    buffer each way, pipeline/batch._pack_args/_unpack_round/_unpack_
+    refine) must be bit-identical to the separate-array protocol the
+    multi-device path ships — if they drift, single-chip and sharded
+    runs diverge silently."""
+    from ccsx_tpu.pipeline import batch as bm
+
+    cfg = CcsConfig(is_bam=False)
+    sm = StarMsa(cfg.align, cfg.max_ins_per_col, cfg.len_bucket_quant)
+    ps = _passes(rng, n=4, tlen=700)
+    qs, qlens, row_mask = sm.pack(ps, cfg.pass_buckets, cfg.max_passes)
+    P, qmax = qs.shape
+    ex = BatchExecutor(cfg)
+    tmax = bm.bucket_len(len(ps[0]), cfg.len_bucket_quant)
+    args = ex._stack_group(
+        [RoundRequest(qs, qlens, row_mask, ps[0])], [0], P, qmax, tmax)
+    bp_consts = ex._bp_consts()
+
+    plain = bm._round_step(cfg.align, cfg.max_ins_per_col, tmax,
+                           bp_consts)(*args)
+    packed = bm._round_step(cfg.align, cfg.max_ins_per_col, tmax,
+                            bp_consts, pack=(P, qmax))(
+                                *bm._pack_args(args))
+    un = bm._unpack_round(np.asarray(packed[0]), np.asarray(packed[1]),
+                          cfg.max_ins_per_col, tmax)
+    for a, b in zip(plain, un):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    rplain = bm._refine_step(cfg.align, cfg.max_ins_per_col, tmax,
+                             cfg.refine_iters, bp_consts)(*args)
+    rpacked = bm._refine_step(cfg.align, cfg.max_ins_per_col, tmax,
+                              cfg.refine_iters, bp_consts,
+                              pack=(P, qmax))(*bm._pack_args(args))
+    run = bm._unpack_refine(np.asarray(rpacked[0]),
+                            np.asarray(rpacked[1]),
+                            cfg.max_ins_per_col, tmax)
+    for a, b in zip(rplain, run):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
