@@ -82,10 +82,21 @@ def measure():
             ge._example_batch(Z=Z, P=P, W=W, tlen=TLEN)]
     # on an accelerator a round is sub-ms: raise the loop count so the
     # marginal (iters-1) x round signal clears the +-ms jitter of the
-    # two checksum fetches (CPU rounds are ~0.5 s; ITERS=25 is plenty)
+    # two checksum fetches (CPU rounds are ~0.5 s; ITERS=25 is plenty).
+    # CCSX_BENCH_ITERS/WINDOWS exist for the watchdog's budgeted CPU
+    # retry, which must fit a full measure in half the watchdog
     iters = ITERS if jax.default_backend() == "cpu" else 200
+
+    def env_int(name, default, lo):
+        try:
+            return max(int(os.environ.get(name, "") or default), lo)
+        except ValueError:
+            return default
+
+    iters = env_int("CCSX_BENCH_ITERS", iters, 2)
+    windows = env_int("CCSX_BENCH_WINDOWS", WINDOWS, 1)
     runs = marginal_time(round_core, *args, iters=iters,
-                         repeats=WINDOWS, settle=0.2)
+                         repeats=windows, settle=0.2)
     return Z / min(runs)  # best window, ZMW-windows per second
 
 
@@ -127,6 +138,11 @@ def main():
         print("[bench] retrying on CPU with reduced e2e", file=sys.stderr)
         line = attempt({"JAX_PLATFORMS": "cpu",
                         "CCSX_BENCH_E2E_HOLES": "4",
+                        # the budgeted retry must fit compile + measure
+                        # + e2e in watchdog/2: 3 windows x (1+10) CPU
+                        # rounds ~ 20 s of measurement
+                        "CCSX_BENCH_ITERS": "10",
+                        "CCSX_BENCH_WINDOWS": "3",
                         "CCSX_BENCH_DEADLINE": "180"}, budget / 2)
         if line is not None:
             # mark the fallback so downstream consumers can't mistake

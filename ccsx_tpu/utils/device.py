@@ -61,11 +61,15 @@ def probe_default_backend(timeout: float | None = None,
         timeout = float(os.environ.get("CCSX_PROBE_TIMEOUT", "120"))
     if retries is None:
         retries = int(os.environ.get("CCSX_PROBE_RETRIES", "1"))
-    # the probe must EXECUTE on the device, not just enumerate: the
-    # tunnel has been observed with jax.devices() healthy while any
-    # dispatch (even a warm trivial jit) hangs forever
-    probe_src = ("import jax, numpy; jax.block_until_ready("
-                 "jax.jit(lambda a: a + 1)(numpy.ones(8)))")
+    # the probe must EXECUTE on the device AND materialize the result,
+    # not just enumerate or block: jax.devices() has been observed
+    # healthy while every dispatch hangs, and on the lazy axon runtime
+    # block_until_ready returns without waiting (r5, memory/axon notes)
+    # — only fetching bytes proves a live round-trip
+    probe_src = ("import sys, jax, numpy; "
+                 "v = numpy.asarray(jax.jit(lambda a: a + 1)"
+                 "(numpy.ones(8))); "
+                 "sys.exit(0 if v[0] == 2 else 1)")
     for attempt in range(retries + 1):
         try:
             r = subprocess.run(
