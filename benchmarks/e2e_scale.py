@@ -91,7 +91,7 @@ def round_metric_inline(backend_ready: bool = True) -> dict:
 
 
 def run_scale(n_holes: int, inflight: int, rng, device: str = "auto",
-              tlen_lo=1000, tlen_hi=5000):
+              tlen_lo=1000, tlen_hi=5000, cli_extra=()):
     with tempfile.TemporaryDirectory() as tmp:
         in_path = os.path.join(tmp, "big.bam")
         zs = make_big_bam(in_path, n_holes, rng, tlen_lo, tlen_hi)
@@ -100,7 +100,7 @@ def run_scale(n_holes: int, inflight: int, rng, device: str = "auto",
         t0 = time.perf_counter()
         rc = cli.main(["--batch", "on", "--inflight", str(inflight),
                        "--metrics", mpath, "--device", device,
-                       in_path, out])
+                       *cli_extra, in_path, out])
         dt = time.perf_counter() - t0
         assert rc == 0, f"rc={rc}"
         got = {r.name: r.seq for r in fastx.read_fastx(out)}
@@ -158,6 +158,9 @@ def main():
     ap.add_argument("--tlen", default="1000,5000",
                     help="template length range lo,hi (smoke runs can "
                          "shrink this)")
+    ap.add_argument("--pass-buckets", default=None,
+                    help="forwarded to the CLI (occupancy/grouping "
+                         "tuning A/B)")
     ap.add_argument("--json", default=None)
     a = ap.parse_args()
     tlen_lo, tlen_hi = (int(x) for x in a.tlen.split(","))
@@ -169,8 +172,12 @@ def main():
     if not a.skip_round:
         res["round_metric"] = round_metric_inline()
     rng = np.random.default_rng(42)
+    extra = (("--pass-buckets", a.pass_buckets)
+             if a.pass_buckets else ())
+    if a.pass_buckets:
+        res["pass_buckets"] = a.pass_buckets
     res["scale"] = run_scale(a.holes, a.inflight, rng, a.device,
-                             tlen_lo, tlen_hi)
+                             tlen_lo, tlen_hi, extra)
     if not a.skip_round:
         rm = res["round_metric"]["zmw_windows_per_sec"]
         ew = res["scale"]["zmw_windows_per_sec"]
@@ -182,7 +189,8 @@ def main():
     if a.floor_holes:
         rng2 = np.random.default_rng(7)
         res["latency_floor"] = run_scale(a.floor_holes, a.inflight, rng2,
-                                         a.device, tlen_lo, tlen_hi)
+                                         a.device, tlen_lo, tlen_hi,
+                                         extra)
     print(json.dumps(res, indent=1))
     if a.json:
         with open(a.json, "w") as f:

@@ -43,6 +43,7 @@ TPU extensions (long options):
 --journal <path>          --metrics <path>        --profile <dir>
 --hosts <int> --host-id <int> --coordinator <addr> --merge-shards <N>
 --make-index              (index INPUT for byte-range sharded ingest)
+--pass-buckets a,b,...    (device pass-padding buckets; default 4,8,16,32)
 """
 
 
@@ -89,6 +90,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "4,2); default: all devices on the data axis")
     p.add_argument("--refine-iters", type=int, default=2)
     p.add_argument("--max-passes", type=int, default=32)
+    p.add_argument("--pass-buckets", default=None, metavar="A,B,...",
+                   help="device pass-padding buckets (ascending ints; "
+                        "the occupancy/grouping tuning knob — "
+                        "ARCHITECTURE.md perf notes)")
     p.add_argument("--fastq", action="store_true", dest="fastq",
                    help="Write FASTQ with per-base vote-margin qualities "
                         "instead of FASTA (extension; the reference "
@@ -151,6 +156,26 @@ def config_from_args(args) -> CcsConfig:
             print(f"Error: --mesh expects D,P integers, got {args.mesh!r}",
                   file=sys.stderr)
             raise SystemExit(1)
+    pass_buckets = None
+    if getattr(args, "pass_buckets", None):
+        try:
+            pass_buckets = tuple(
+                int(x) for x in args.pass_buckets.split(","))
+            if (not pass_buckets or min(pass_buckets) < 1
+                    or list(pass_buckets) != sorted(set(pass_buckets))):
+                raise ValueError
+        except ValueError:
+            print("Error: --pass-buckets expects ascending positive "
+                  f"integers, got {args.pass_buckets!r}", file=sys.stderr)
+            raise SystemExit(1)
+        if pass_buckets[-1] < args.max_passes:
+            # an undersized bucket list would silently defeat shape
+            # bucketing: holes above the last bucket ship with their raw
+            # pass count, one XLA compile per distinct count
+            print(f"Error: --pass-buckets last bucket "
+                  f"{pass_buckets[-1]} must cover --max-passes "
+                  f"{args.max_passes}", file=sys.stderr)
+            raise SystemExit(1)
     return CcsConfig(
         min_subread_len=args.min_len,
         max_subread_len=args.max_len,
@@ -168,6 +193,7 @@ def config_from_args(args) -> CcsConfig:
         mesh_shape=mesh_shape,
         device=args.device,
         metrics_path=args.metrics,
+        **({"pass_buckets": pass_buckets} if pass_buckets else {}),
     )
 
 

@@ -377,6 +377,14 @@ class StarMsa:
         if len(passes) > max_passes:
             passes = passes[:max_passes]
         P = pass_bucket(len(passes), pass_buckets)
+        # an undersized bucket list must fail loudly here, not ship a
+        # raw-pass-count shape that silently defeats bucketing (one XLA
+        # compile per distinct count); the CLI validates buckets vs
+        # max_passes up front — this guards library callers
+        if P < len(passes):
+            raise ValueError(
+                f"pass_buckets {tuple(pass_buckets)} do not cover "
+                f"{len(passes)} passes (max_passes={max_passes})")
         if qmax is None:
             qmax = bucket_len(max(len(p) for p in passes), self.len_quant)
         qs = np.stack(

@@ -1,0 +1,63 @@
+"""The shared forced-execution timing helper (benchmarks/marginal_time).
+
+This is the measurement layer every perf artifact now rests on (the
+lazy-runtime discovery, r5) — pin its contract: positive marginals for
+real work, scaling with workload, and an honest refusal when no window
+yields a positive sample.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks"))
+
+from marginal_time import marginal_time  # noqa: E402
+
+
+def _work(n):
+    def f(x):
+        import jax.numpy as jnp
+
+        y = x.astype(jnp.float32)
+        for _ in range(n):
+            y = y * 1.0001 + 0.5
+        return y
+    return f
+
+
+def test_positive_and_scales_with_workload():
+    x = np.arange(1 << 16, dtype=np.int32)
+    light = min(marginal_time(_work(4), x, iters=40, repeats=3))
+    heavy = min(marginal_time(_work(400), x, iters=40, repeats=3))
+    assert light > 0 and heavy > 0
+    # 100x the elementwise chain must cost measurably more per call —
+    # the property the lazy runtime's fake timings violated
+    assert heavy > 3 * light, (light, heavy)
+
+
+def test_refuses_when_no_positive_sample():
+    # a no-op measured at iters=2 on a host under load: force the
+    # pathological all-nonpositive case deterministically by patching
+    # the clock to stand still
+    import time as _t
+
+    import marginal_time as mt
+
+    seq = iter([0.0, 1.0, 1.0, 1.0] * 20)  # base=1.0, run_n dt=0.0
+
+    class FakeTime:
+        perf_counter = staticmethod(lambda: next(seq))
+        sleep = staticmethod(lambda s: None)
+
+    mt.time = FakeTime()
+    try:
+        with pytest.raises(RuntimeError, match="nonpositive"):
+            marginal_time(_work(1), np.arange(128, dtype=np.int32),
+                          iters=3, repeats=2)
+    finally:
+        mt.time = _t
