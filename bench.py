@@ -74,7 +74,10 @@ def measure():
     # bookkeeping).  The trade: no cross-round overlap is counted — a
     # round is itself a (Z*P)-problem batch, so the chip is already
     # saturated within one round.
-    sys.path.insert(0, os.path.join(
+    # APPEND, never insert(0): the benchmarks dir holds generically
+    # named modules (e2e, quality, ...) that would otherwise shadow
+    # same-named imports resolved later in this process
+    sys.path.append(os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
     from marginal_time import marginal_time
 
@@ -166,7 +169,8 @@ def _inner_main():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     if calibrate:
         # re-measure the native CPU yardstick and store the projections
-        sys.path.insert(0, os.path.join(
+        # (append, not insert(0) — see the note in measure())
+        sys.path.append(os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
         import cpu_baseline
 
@@ -245,7 +249,8 @@ def _inner_main():
         # driver timeout is worse than skipping tail configs
         deadline = time.monotonic() + float(
             os.environ.get("CCSX_BENCH_DEADLINE", "420"))
-        sys.path.insert(0, os.path.join(
+        # append, not insert(0) — see the note in measure()
+        sys.path.append(os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
         import e2e as e2e_mod
 

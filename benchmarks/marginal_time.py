@@ -34,6 +34,18 @@ def marginal_time(fn, *args, iters: int = 100, repeats: int = 3,
     ``fn(*args)``.  May return fewer (noisy windows are discarded, with
     up to 2x``repeats`` attempts); raises RuntimeError if every attempt
     was nonpositive — a sign the runtime/clock is broken, not the chip.
+
+    FIRST-ARGUMENT CONTRACT: the anti-hoisting perturbation writes
+    ``i % 4`` into element [0, 0, ...] of ``args[0]`` each loop
+    iteration, so args[0] must tolerate arbitrary values in {0, 1, 2, 3}
+    at that position — same dtype, same output shapes, no control-flow
+    change.  True of the code tensors every ccsx bench passes first
+    (0..3 are the valid bases; lengths/masks ride in later arguments).
+    Callers whose natural first argument cannot absorb that (a length,
+    a scalar, a one-hot) must reorder arguments so a value-tolerant
+    tensor comes first — the perturbed value feeds ``fn``, so a
+    corrupted length would time a DIFFERENT workload, not just add
+    noise.
     """
     import jax
     import jax.numpy as jnp

@@ -43,6 +43,7 @@ from ccsx_tpu.consensus.windowed import consensus_windowed   # noqa: E402
 from ccsx_tpu.io import bam, fastx                           # noqa: E402
 from ccsx_tpu.ops import encode as enc                       # noqa: E402
 from ccsx_tpu.utils import synth                             # noqa: E402
+from ccsx_tpu.utils.fingerprint import code_fingerprint      # noqa: E402
 
 # per-pass subread error rates (PacBio CLR-like: ~10-13% total, indel
 # heavy).  The gate distribution draws pass counts log-normally: median
@@ -352,6 +353,12 @@ def main():
            # checkpoint instead of silently mixing old-model sections
            # into an artifact that reports the new models
            "holes": a.holes, "full": bool(a.full),
+           # ... and the same CODE: the consensus-source fingerprint
+           # (shared with journal v2, utils/fingerprint.py) invalidates
+           # a checkpoint cut by a crashed run of OLDER code, which
+           # would otherwise silently mix old-code sections into an
+           # artifact attributed to current HEAD
+           "code_fingerprint": code_fingerprint(),
            # json round-trip so the == check against a reloaded .partial
            # compares like with like (tuples become lists)
            "error_models": json.loads(json.dumps(
@@ -366,16 +373,14 @@ def main():
         try:
             with open(a.json + ".partial") as f:
                 prev = json.load(f)
-            if all(prev.get(k) == res[k] for k in
-                   ("backend", "qv_coeffs", "holes", "full",
-                    "error_models")):
+            compat_keys = ("backend", "qv_coeffs", "holes", "full",
+                           "error_models", "code_fingerprint")
+            if all(prev.get(k) == res[k] for k in compat_keys):
                 done = prev
                 print(f"[quality] resuming from {a.json}.partial "
                       f"(sections: {sorted(done)})", file=sys.stderr)
             else:
-                bad = [k for k in ("backend", "qv_coeffs", "holes",
-                                   "full", "error_models")
-                       if prev.get(k) != res[k]]
+                bad = [k for k in compat_keys if prev.get(k) != res[k]]
                 print(f"[quality] IGNORING {a.json}.partial: mismatched "
                       f"{bad} — recomputing all sections", file=sys.stderr)
         except (OSError, ValueError):

@@ -42,8 +42,10 @@ TPU extensions (long options):
 --refine-iters <int>      --max-passes <int>      --window-growth {flush,grow}
 --journal <path>          --metrics <path>        --profile <dir>
 --hosts <int> --host-id <int> --coordinator <addr> --merge-shards <N>
+--merge-unmarked          (merge a legacy shard set without .done markers)
 --make-index              (index INPUT for byte-range sharded ingest)
 --pass-buckets a,b,...    (device pass-padding buckets; default 4,8,16,32)
+--inject-faults p@N,...   (deterministic fault injection; testing only)
 """
 
 
@@ -130,10 +132,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "(optional; enables cross-host collectives)")
     p.add_argument("--merge-shards", type=int, default=None, metavar="N",
                    help="Merge OUTPUT.shard0..N-1 into OUTPUT and exit")
+    p.add_argument("--merge-unmarked", action="store_true",
+                   help="With --merge-shards: merge a shard set that has "
+                        "NO completion markers at all (a legacy set "
+                        "predating markers; indistinguishable from a "
+                        "node-wide mid-run kill, so never assumed)")
     p.add_argument("--make-index", action="store_true",
                    help="Build INPUT's BGZF hole index sidecar "
                         "(<INPUT>.ccsx_idx) for byte-range sharded "
                         "multi-host ingest, then exit")
+    p.add_argument("--inject-faults", default=None, metavar="SPEC",
+                   help="Deterministic fault injection for testing "
+                        "recovery paths: point@N[+],... with points "
+                        "ingest, compute, device_oom, write, journal "
+                        "(utils/faultinject.py; CCSX_FAULTS env "
+                        "equivalent)")
     return p
 
 
@@ -206,6 +219,15 @@ def main(argv: Optional[list] = None) -> int:
     except SystemExit as e:
         return int(e.code or 0)
 
+    if args.inject_faults:
+        from ccsx_tpu.utils import faultinject
+
+        try:
+            faultinject.arm(args.inject_faults)
+        except ValueError as e:
+            print(f"Error: --inject-faults: {e}", file=sys.stderr)
+            return 1
+
     # imports deferred so --help stays fast and backend selection happens
     # after the config is known
     if args.make_index:
@@ -229,7 +251,14 @@ def main(argv: Optional[list] = None) -> int:
     if args.merge_shards is not None:
         from ccsx_tpu.parallel.distributed import merge_shards
 
-        n = merge_shards(args.output, args.merge_shards)
+        try:
+            n = merge_shards(args.output, args.merge_shards,
+                             allow_unmarked=args.merge_unmarked)
+        except (OSError, ValueError) as e:
+            # incomplete/dead shards or unreadable files: a designed,
+            # expected operational refusal — clean rc 1, no traceback
+            print(f"Error: {e}", file=sys.stderr)
+            return 1
         print(f"[ccsx-tpu] merged {n} records from {args.merge_shards} "
               "shards", file=sys.stderr)
         return 0

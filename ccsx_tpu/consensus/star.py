@@ -55,9 +55,34 @@ def pad_to(x: np.ndarray, n: int) -> np.ndarray:
     return out
 
 
+# set by force_scan_fallback when a Pallas lowering/compile failure is
+# caught at dispatch (pipeline/batch.py recovery): the scan spec is
+# always available, so a broken Mosaic toolchain degrades to the
+# interpretable implementation instead of killing the run
+_FORCE_SCAN = False
+
+
+def force_scan_fallback(reason: str) -> bool:
+    """Pin the banded fill to the lax.scan spec for the rest of this
+    process (overriding CCSX_BANDED_IMPL=pallas).  Returns True the
+    first time — the caller should retry its dispatch — and False if the
+    scan was already forced (the failure is not the kernel's)."""
+    global _FORCE_SCAN
+    if _FORCE_SCAN:
+        return False
+    _FORCE_SCAN = True
+    import sys
+
+    print("[ccsx-tpu] Pallas kernel failed to lower/compile; falling "
+          f"back to the banded-scan spec for this run ({reason})",
+          file=sys.stderr)
+    return True
+
+
 def use_pallas() -> bool:
     """Banded DP-fill implementation choice; CCSX_BANDED_IMPL overrides
-    ({pallas, scan}).  The scan implementation is the spec — the G-batched
+    ({pallas, scan}), and a compile-failure fallback
+    (force_scan_fallback) overrides both.  The scan implementation is the spec — the G-batched
     kernel (ops/banded_pallas.py) is differential-tested bit-exact against
     it, on real TPU hardware with interpret=False (benchmarks/pallas_ab.py
     --mode check, 2026-07-29, v5e) as well as in interpret mode
@@ -72,6 +97,8 @@ def use_pallas() -> bool:
     the full round.  The kernel stays available for A/B runs
     (CCSX_BANDED_IMPL=pallas) and as the fallback position if XLA's scan
     lowering regresses."""
+    if _FORCE_SCAN:
+        return False
     impl = os.environ.get("CCSX_BANDED_IMPL", "")
     if impl not in ("", "pallas", "scan"):
         raise ValueError(

@@ -23,6 +23,21 @@ class FastxRecord:
     qual: Optional[bytes]  # None for FASTA
 
 
+def format_record(name: str, seq: bytes,
+                  qual: Optional[bytes] = None) -> "tuple[str, int]":
+    """(text, nbytes) of ONE output record — FASTA (2-line) without
+    ``qual``, FASTQ (4-line) with it.  THE single formatter both output
+    writers share (pipeline/run._PyWriter, parallel ShardWriter):
+    nbytes is the UTF-8-encoded length, which feeds journal v2's
+    torn-tail truncation offsets, so format and accounting must never
+    diverge between drivers."""
+    if qual is None:
+        rec = f">{name}\n{seq.decode()}\n"
+    else:
+        rec = f"@{name}\n{seq.decode()}\n+\n{qual.decode()}\n"
+    return rec, len(rec.encode("utf-8"))
+
+
 def _open(path_or_file) -> io.BufferedReader:
     if hasattr(path_or_file, "read"):
         f = path_or_file

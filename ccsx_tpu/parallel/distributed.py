@@ -30,12 +30,14 @@ output byte-identical (metrics.ingest_bytes records each mode's cost).
 from __future__ import annotations
 
 import heapq
+import json
 import os
 import sys
 from typing import Iterator, Optional
 
 from ccsx_tpu.config import CcsConfig
-from ccsx_tpu.utils.journal import Journal
+from ccsx_tpu.io import fastx
+from ccsx_tpu.utils.journal import Journal, write_json_atomic
 from ccsx_tpu.utils.metrics import Metrics
 
 
@@ -70,6 +72,34 @@ def shard_path(out_path: str, rank: int) -> str:
     return f"{out_path}.shard{rank}"
 
 
+def done_path(out_path: str, rank: int) -> str:
+    """Per-shard completion marker: written atomically by a rank that
+    drained its stream cleanly; its absence is how merge_shards knows a
+    shard DIED rather than merely produced few records (a silently
+    short merge would drop that rank's holes)."""
+    return shard_path(out_path, rank) + ".done"
+
+
+def _write_done_marker(out_path: str, rank: int, n: int,
+                       holes_done: int) -> None:
+    # records counted from the closed (fsynced) ordinal sidecar, so a
+    # resumed run's marker covers prior runs' records too
+    records = 0
+    try:
+        with open(shard_path(out_path, rank) + ".idx") as fi:
+            records = sum(1 for line in fi if not line.startswith("#"))
+    except OSError:
+        pass
+    # fsynced like the journal (same shared idiom, write_json_atomic):
+    # the marker VOUCHES for the shard bytes — merge_shards trusts its
+    # existence — so it must never become durable while unfsynced shard
+    # data could still be lost to a power cut; ShardWriter.close fsyncs
+    # both shard files first
+    write_json_atomic(done_path(out_path, rank),
+                      {"rank": rank, "hosts": n, "records": records,
+                       "holes_done": holes_done})
+
+
 class ShardWriter:
     """FASTA shard + sidecar of global hole ordinals, for exact merge.
 
@@ -89,35 +119,78 @@ class ShardWriter:
         self.start_ordinal = start_ordinal
         mode = "a" if append else "w"
         self.path = shard_path(out_path, rank)
-        self._f = open(self.path, mode)
-        self._idx = open(self.path + ".idx", mode)
+        # UTF-8 pinned: bytes_out counts encoded bytes (non-ASCII read
+        # names must not skew the journal's truncation offsets)
+        self._f = open(self.path, mode, encoding="utf-8")
+        self._idx = open(self.path + ".idx", mode, encoding="utf-8")
+        # byte accounting for journal v2's torn-tail recovery; resumes
+        # continue from the on-disk sizes the journal already verified
+        self.bytes_out = os.path.getsize(self.path) if append else 0
+        self.idx_bytes_out = (os.path.getsize(self.path + ".idx")
+                              if append else 0)
         if not append:
             # the sharding mode is chosen per-rank from local state (a
             # BGZF index sidecar may be fresh on one host and stale on
             # another); a mixed-mode run would interleave overlapping
             # ordinal spaces into a silently corrupt merge, so each
             # shard declares its mode and merge_shards refuses a mix
-            self._idx.write("#mode=range\n" if start_ordinal is not None
-                            else "#mode=rr\n")
+            hdr = ("#mode=range\n" if start_ordinal is not None
+                   else "#mode=rr\n")
+            self._idx.write(hdr)
+            self.idx_bytes_out += len(hdr)
 
     def put_at(self, local_idx: int, name: str, seq: bytes,
                qual: bytes | None = None) -> None:
-        if qual is None:
-            self._f.write(f">{name}\n{seq.decode()}\n")
-        else:
-            self._f.write(f"@{name}\n{seq.decode()}\n+\n{qual.decode()}\n")
+        rec, nbytes = fastx.format_record(name, seq, qual)
+        self._f.write(rec)
+        self.bytes_out += nbytes
         ordinal = (self.rank + local_idx * self.n
                    if self.start_ordinal is None
                    else self.start_ordinal + local_idx)
-        self._idx.write(f"{ordinal}\n")
+        line = f"{ordinal}\n"
+        self._idx.write(line)
+        self.idx_bytes_out += len(line)
 
     def put(self, name: str, seq: bytes,
             qual: bytes | None = None) -> None:  # pragma: no cover
         raise RuntimeError("ShardWriter requires put_at")
 
+    def flush(self) -> None:
+        # both streams, record before sidecar (a crash between the two
+        # leaves an indexless record tail, which verify_output truncates)
+        self._f.flush()
+        self._idx.flush()
+
     def close(self) -> None:
-        self._f.close()
-        self._idx.close()
+        # fsync both shard files: the completion marker written after
+        # close vouches for these bytes, so they must be durable first.
+        # A REAL fsync failure (e.g. EIO: writeback lost dirty pages)
+        # must propagate — rc becomes 1 and the marker is suppressed —
+        # and only genuinely-unsupported fsync is ignored.
+        import errno
+
+        err = None
+        for f in (self._f, self._idx):
+            try:
+                try:
+                    f.flush()
+                    os.fsync(f.fileno())
+                except OSError as e:
+                    if e.errno not in (errno.EINVAL, errno.ENOTSUP,
+                                       getattr(errno, "EOPNOTSUPP", -1)):
+                        err = err or e
+                except ValueError:
+                    pass  # already closed (double close): nothing to sync
+            finally:
+                # BOTH streams always get closed, and the FIRST error is
+                # the one reported (an unguarded close() re-raising the
+                # flush failure would skip the sidecar entirely)
+                try:
+                    f.close()
+                except OSError as e:
+                    err = err or e
+        if err is not None:
+            raise err
 
 
 def run_pipeline_sharded(in_path: str, out_path: str, cfg: CcsConfig,
@@ -184,7 +257,19 @@ def run_pipeline_sharded(in_path: str, out_path: str, cfg: CcsConfig,
     # spaces differ)
     mode_id = (f"{in_path}#range{rank}/{n}" if range_lo is not None
                else f"{in_path}#{rank}/{n}")
-    journal = Journal.load_or_create(jp, input_id=mode_id)
+    # load under this run's fingerprint + reconcile BOTH shard files
+    # (record + ordinal sidecar) with the cursor before appending: a
+    # crash can tear either tail
+    sp = shard_path(out_path, rank)
+    journal = Journal.for_run(jp, mode_id, cfg, sp, sp + ".idx")
+    # retract the stale completion marker BEFORE the writer can truncate
+    # the shard files: the reverse order leaves a crash window where a
+    # durable marker vouches for an already-truncated shard and the
+    # merge goes silently short
+    try:
+        os.unlink(done_path(out_path, rank))
+    except OSError:
+        pass
     try:
         writer = ShardWriter(out_path, rank, n,
                              append=bool(journal.holes_done),
@@ -211,14 +296,68 @@ def run_pipeline_sharded(in_path: str, out_path: str, cfg: CcsConfig,
         # share; round-robin: interleave-filter the shared full stream
         shard = (stream if range_lo is not None
                  else shard_stream(stream, rank, n))
-        return drive_batched(shard, writer, cfg, journal, metrics,
-                             inflight or cfg.zmw_microbatch)
+        rc = drive_batched(shard, writer, cfg, journal, metrics,
+                           inflight or cfg.zmw_microbatch)
+    if rc == 0:
+        _write_done_marker(out_path, rank, n, journal.holes_done)
+    return rc
 
 
-def merge_shards(out_path: str, n: int, cleanup: bool = True) -> int:
+def merge_shards(out_path: str, n: int, cleanup: bool = True,
+                 allow_unmarked: bool = False) -> int:
     """K-way merge of <out>.shard0..n-1 by global hole ordinal into
     out_path; returns the record count.  Restores exactly the single-host
-    output order."""
+    output order.
+
+    Every rank must have written its completion marker (done_path): a
+    rank that died mid-run leaves a plausible-looking partial shard, and
+    merging it would produce a silently short output — refused instead,
+    naming exactly which shard(s) died and how far each got.  That
+    includes ALL ranks missing (a node-wide kill looks exactly like a
+    pre-marker legacy shard set, and guessing "legacy" would silently
+    drop holes); a caller who KNOWS the set is legacy-complete passes
+    ``allow_unmarked=True``."""
+    dead = []
+    for r in range(n):
+        if os.path.exists(done_path(out_path, r)):
+            # the marker records the host count its run was sharded
+            # over: merging a K-host set with --merge-shards N<K would
+            # pass the existence check for shards 0..N-1 and silently
+            # drop shards N..K-1's holes — refuse the mismatch instead
+            try:
+                with open(done_path(out_path, r)) as f:
+                    hosts = json.load(f).get("hosts")
+            except (OSError, ValueError):
+                hosts = None  # unreadable marker: can't vouch -> dead
+            if hosts == n:
+                continue
+            if hosts is not None:
+                raise ValueError(
+                    f"shard{r}'s completion marker says the run used "
+                    f"{hosts} hosts, but --merge-shards got {n}; "
+                    f"merge with the run's host count ({hosts})")
+        p = shard_path(out_path, r)
+        if os.path.exists(p + ".idx"):
+            with open(p + ".idx") as fi:
+                recs = sum(1 for line in fi if not line.startswith("#"))
+            dead.append(f"shard{r} (died after {recs} durable records)")
+        elif os.path.exists(p):
+            dead.append(f"shard{r} (no ordinal sidecar)")
+        else:
+            dead.append(f"shard{r} (never started: no shard file)")
+    if dead and allow_unmarked and len(dead) == n:
+        print(f"[ccsx-tpu] merge: no completion markers on any of {n} "
+              "shards; merging anyway (allow_unmarked) — completion "
+              "cannot be verified", file=sys.stderr)
+    elif dead:
+        hint = ("; if every rank is unmarked because the shards predate "
+                "completion markers, merge with --merge-unmarked "
+                "(allow_unmarked=True)" if len(dead) == n else "")
+        raise ValueError(
+            "refusing to merge incomplete shards — a merge now would "
+            f"silently drop their holes: {'; '.join(dead)}; re-run the "
+            "dead rank(s) (with --journal they resume from their shard "
+            f"cursor), then merge again{hint}")
 
     def shard_mode(rank: int) -> str:
         with open(shard_path(out_path, rank) + ".idx") as fi:
@@ -262,4 +401,8 @@ def merge_shards(out_path: str, n: int, cleanup: bool = True) -> int:
             p = shard_path(out_path, r)
             os.unlink(p)
             os.unlink(p + ".idx")
+            try:
+                os.unlink(done_path(out_path, r))
+            except OSError:
+                pass  # pre-marker shard sets (legacy) have none
     return count

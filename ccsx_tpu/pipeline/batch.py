@@ -30,6 +30,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import sys
+import time
 from collections import defaultdict
 from typing import Dict, List, Optional
 
@@ -47,8 +48,142 @@ from ccsx_tpu.consensus.star import (
 from ccsx_tpu.ops import banded
 from ccsx_tpu.ops import encode as enc
 from ccsx_tpu.ops import traceback
+from ccsx_tpu.utils import faultinject
 from ccsx_tpu.utils.journal import Journal
 from ccsx_tpu.utils.metrics import Metrics
+
+
+# ---- failure taxonomy (the fault-tolerance layer's classification of
+# ---- exceptions escaping a jitted device dispatch; ARCHITECTURE.md
+# ---- "Failure domains") ---------------------------------------------------
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "OUT OF MEMORY", "FAILED TO ALLOCATE")
+# DELIBERATELY narrow: only the TPU-kernel toolchain's own names.  Broad
+# words ("compile", "unsupported", "lowering") also appear in ordinary
+# Python/data errors — e.g. TypeError "unsupported operand" — and a
+# false 'compile' here would pin the process-wide scan fallback and
+# misdiagnose a single bad hole.  A kernel compile failure that slips
+# past these markers still lands safely: classified 'data', replayed on
+# the host path (which is the scan spec anyway).
+_COMPILE_MARKERS = ("MOSAIC", "PALLAS")
+# deliberate validation errors in our own code (e.g. banded_pallas's
+# "qmax exceeds PALLAS_MAX_QMAX" / "CCSX_PALLAS_GBLOCK" ValueErrors)
+# mention the kernel by name but are per-group DATA conditions — the
+# compiler toolchain never raises these builtin types
+_DATA_EXC_TYPES = (ValueError, TypeError, KeyError, IndexError,
+                   AssertionError)
+
+
+def classify_failure(exc: BaseException) -> str:
+    """'oom' | 'compile' | 'data' for an exception from a device dispatch.
+
+    String-matched on the message (+ exception type name): XLA surfaces
+    both allocator exhaustion and compiler failures as XlaRuntimeError
+    subclasses whose types differ across jaxlib versions, but whose
+    status-code prefixes (RESOURCE_EXHAUSTED, ...) are stable.  'oom'
+    and 'compile' are TRANSIENT-DEVICE failures with a recovery ladder
+    (resplit / scan fallback / host replay); 'data' means the inputs or
+    our own code are at fault — replayed per-hole on the host path so
+    the blast radius is one quarantined hole, never the run."""
+    msg = f"{type(exc).__name__}: {exc}".upper()
+    if any(m in msg for m in _OOM_MARKERS):
+        return "oom"
+    if (any(m in msg for m in _COMPILE_MARKERS)
+            and not isinstance(exc, _DATA_EXC_TYPES)):
+        return "compile"
+    return "data"
+
+
+# ---- failure recovery (shared by BatchExecutor and PairExecutor) ---------
+
+def _run_group_sync(idxs, key, dispatch, finish, host_one, results,
+                    metrics, depth, max_resplits, backoff_s,
+                    compile_retried=False) -> None:
+    """Dispatch+materialize one (sub)group synchronously, recovering
+    from failures (used on the resplit/retry paths, where the happy
+    path's dispatch-all-then-materialize overlap no longer applies)."""
+    try:
+        finish(idxs, key, dispatch(idxs, key))
+    except Exception as e:
+        _recover_group(e, idxs, key, dispatch, finish, host_one, results,
+                       metrics, depth, max_resplits, backoff_s,
+                       compile_retried)
+
+
+def _recover_group(exc, idxs, key, dispatch, finish, host_one, results,
+                   metrics, depth, max_resplits, backoff_s,
+                   compile_retried=False) -> None:
+    """The adaptive-retry ladder for one failed shape group.
+
+    oom     -> bisect idxs (halves run at half the Z/N bucket), with
+               exponential backoff and capped depth
+    compile -> pin the banded fill to the scan spec (one-time per
+               process) and retry THIS group once.  The once-per-group
+               retry is tracked separately from the once-per-process
+               pin: in a dispatch-all sweep every group may have failed
+               BEFORE the first recovery pinned the scan, and each
+               deserves its one batched scan retry rather than the far
+               slower per-request host replay
+    data / ladder bottom -> replay each request on the host path;
+               a host failure becomes that request's result (an
+               Exception the driver quarantines per hole)
+    """
+    kind = classify_failure(exc)
+    if kind == "compile" and not compile_retried:
+        from ccsx_tpu.consensus import star as star_mod
+
+        if star_mod.force_scan_fallback(f"{type(exc).__name__}: {exc}") \
+                and metrics is not None:
+            metrics.compile_fallbacks += 1
+        return _run_group_sync(idxs, key, dispatch, finish, host_one,
+                               results, metrics, depth, max_resplits,
+                               backoff_s, compile_retried=True)
+    if kind == "oom" and depth < max_resplits and len(idxs) > 1:
+        if metrics is not None:
+            metrics.oom_resplits += 1
+        print(f"[ccsx-tpu] device OOM on a {len(idxs)}-request group "
+              f"{key}: resplitting (depth {depth + 1}): {exc}",
+              file=sys.stderr)
+        time.sleep(backoff_s * (2 ** depth))
+        mid = (len(idxs) + 1) // 2
+        for part in (idxs[:mid], idxs[mid:]):
+            _run_group_sync(part, key, dispatch, finish, host_one,
+                            results, metrics, depth + 1, max_resplits,
+                            backoff_s, compile_retried)
+        return
+    print(f"[ccsx-tpu] device dispatch failed ({kind}) for a "
+          f"{len(idxs)}-request group {key}; replaying on the host "
+          f"path: {exc}", file=sys.stderr)
+    for i in idxs:
+        if metrics is not None:
+            metrics.host_fallbacks += 1
+        try:
+            results[i] = host_one(i)
+        except Exception as he:  # quarantined per hole by the driver
+            results[i] = he
+
+
+def _run_groups_recovering(groups, dispatch, finish, host_one, results,
+                           metrics, max_resplits=3,
+                           backoff_s=0.05) -> None:
+    """Happy path: dispatch every group's device work before
+    materializing any result (jit dispatch is async, so group B's
+    compute overlaps group A's d2h transfer); failures at either
+    phase drop that one group into the recovery ladder."""
+    pending = []
+    for key, idxs in groups.items():
+        try:
+            pending.append((idxs, key, None, dispatch(idxs, key)))
+        except Exception as e:
+            pending.append((idxs, key, e, None))
+    for idxs, key, exc, out in pending:
+        try:
+            if exc is not None:
+                raise exc
+            finish(idxs, key, out)
+        except Exception as e:
+            _recover_group(e, idxs, key, dispatch, finish, host_one,
+                           results, metrics, 0, max_resplits, backoff_s)
 
 
 @functools.lru_cache(maxsize=128)
@@ -292,7 +427,11 @@ def _refine_step(params: AlignParams, max_ins: int, tmax: int, iters: int,
         # reproduces the kept outputs exactly) — costs one extra full
         # round of compute per window (~1/(iters+1) e2e).  On v5e the Z
         # buckets fit comfortably, so we spend the memory; flip to the
-        # recompute form if a larger chip/bucket ever OOMs here.
+        # recompute form if a larger chip/bucket ever OOMs here.  (Since
+        # the fault-tolerance layer, an OOM here no longer kills the
+        # run: BatchExecutor._recover bisects the Z batch and retries —
+        # the recompute form remains the right STRUCTURAL fix if
+        # resplits ever show up in metrics.oom_resplits at steady state.)
         # pad holes (all-False row_mask) start frozen so they can't keep
         # the while_loop alive
         fixed0 = ~row_mask.any(axis=1)
@@ -391,6 +530,12 @@ class PairExecutor:
     are seeded on the host (ops/seed.py), grouped by padded (qmax, tmax)
     bucket, and filled in ONE batched local-mode banded DP per group —
     the same shape-bucketing discipline as the consensus rounds.
+
+    Shares the failure-containment ladder with BatchExecutor
+    (_run_groups_recovering): an OOM on a pair bucket bisects and
+    retries, and the last resort replays each pair through
+    HostAligner.strand_match — the per-hole spec path, so results stay
+    identical.
     """
 
     def __init__(self, params: AlignParams, quant: int = 512,
@@ -398,6 +543,7 @@ class PairExecutor:
         self.params = params
         self.quant = quant
         self.metrics = metrics
+        self._host_aligner = None  # built lazily, on first fallback
 
     def run(self, pairs: List["prep_mod.PairRequest"]):
         """Satisfy all pair requests; results align index-for-index as
@@ -425,8 +571,14 @@ class PairExecutor:
         if self.metrics is not None:
             self.metrics.pair_alignments += len(lines)
             self.metrics.device_dispatches += len(groups)
-        pending = []
-        for (qmax, tmax), idxs in groups.items():
+            for (qmax, tmax), idxs in groups.items():
+                N = _z_bucket(len(idxs))
+                self.metrics.dp_cells_padded += N * qmax * self.params.band
+                self.metrics.dp_cells_real += self.params.band * int(
+                    sum(len(pairs[i].q) for i in idxs))
+
+        def dispatch(idxs, key):
+            qmax, tmax = key
             N = _z_bucket(len(idxs))
             # PAD-filled so the dummy tail slots look exactly like the
             # old pad_to(empty) rows (qlen/tlen stay 0 in `small`)
@@ -438,14 +590,11 @@ class PairExecutor:
                 small[z, 0] = len(pairs[i].q)
                 small[z, 1] = len(pairs[i].t)
                 small[z, 2:6] = lines[i]
-            if self.metrics is not None:
-                self.metrics.dp_cells_padded += N * qmax * self.params.band
-                self.metrics.dp_cells_real += (int(small[:, 0].sum())
-                                               * self.params.band)
-            # async-dispatch every bucket before reading any back
+            faultinject.fire("device_oom")
             step = _pair_fill_packed(self.params, qmax, tmax)
-            pending.append((idxs, step(big, small)))
-        for idxs, res in pending:
+            return step(big, small)
+
+        def finish(idxs, key, res):
             res = np.asarray(res)
             for z, i in enumerate(idxs):
                 score, qb, qe, tb, te, aln, mat = (
@@ -459,6 +608,17 @@ class PairExecutor:
                 rs.ok = (rs.aln * 2 > min(len(pr.q), len(pr.t))) and (
                     rs.mat * 100 >= rs.aln * pr.pct)
                 results[i] = (rs.ok, rs)
+
+        def host_one(i):
+            if self._host_aligner is None:
+                from ccsx_tpu.consensus.align_host import HostAligner
+
+                self._host_aligner = HostAligner(self.params)
+            pr = pairs[i]
+            return self._host_aligner.strand_match(pr.q, pr.t, pr.pct)
+
+        _run_groups_recovering(groups, dispatch, finish, host_one,
+                               results, self.metrics)
         return results
 
 
@@ -471,7 +631,22 @@ class BatchExecutor:
     ``data`` mesh (ZMW axis sharded, SURVEY.md §5.8): the jitted round is
     pure vmap, so XLA partitions it across the chips of a slice with no
     cross-device traffic in the DP itself.
+
+    Failure containment (per shape group; see classify_failure): a
+    device OOM bisects the group and retries the halves at half the Z
+    batch (with capped depth and exponential backoff) — memory pressure
+    scales with Z, so one oversized bucket costs a resplit instead of
+    the run; a Pallas lowering/compile failure pins the fill to the
+    banded-scan spec (star.force_scan_fallback, one-time warning) and
+    retries; anything else — and the bottom of both ladders — replays
+    each request on the exact host path (bit-identical by the
+    differential tests), with per-request host failures returned as
+    Exception results the driver quarantines per hole.
     """
+
+    # OOM resplit ladder: up to Z/8 before the per-request host replay
+    max_oom_resplits = 3
+    oom_backoff_s = 0.05
 
     def __init__(self, cfg: CcsConfig, metrics=None):
         self.cfg = cfg
@@ -631,6 +806,11 @@ class BatchExecutor:
                 results[i] = res
         return results
 
+    def _run_groups(self, groups, dispatch, finish, host_one, results):
+        _run_groups_recovering(groups, dispatch, finish, host_one,
+                               results, self.metrics,
+                               self.max_oom_resplits, self.oom_backoff_s)
+
     def _run_rounds(self, requests: List[RoundRequest]) -> List[RoundResult]:
         cfg = self.cfg
         groups: Dict[tuple, List[int]] = defaultdict(list)
@@ -644,24 +824,22 @@ class BatchExecutor:
             # bare rounds (legacy/test path) count as dispatches only —
             # 'windows' counts RefineRequests (one per window attempt)
             self.metrics.device_dispatches += len(groups)
-        # dispatch every group's device work before materializing any
-        # result: jit dispatch is async, so group B's compute overlaps
-        # group A's d2h transfer
-        pending = []
-        for (P, qmax, tmax), idxs in groups.items():
+
+        def dispatch(idxs, key):
+            P, qmax, tmax = key
             args = self._stack_group(requests, idxs, P, qmax, tmax)
-            self._count_cells(requests, idxs, P, qmax, args[0].shape[0])
+            faultinject.fire("device_oom")
             if self._mesh is None:
                 # packed single-device transfers, as in _run_refine
                 step = _round_step(cfg.align, cfg.max_ins_per_col, tmax,
                                    self._bp_consts(), pack=(P, qmax))
-                pending.append((idxs, tmax, step(*_pack_args(args))))
-            else:
-                step = _round_step(cfg.align, cfg.max_ins_per_col, tmax,
-                                   self._bp_consts())
-                pending.append(
-                    (idxs, tmax, step(*self._shard_args(args, P))))
-        for idxs, tmax, out in pending:
+                return step(*_pack_args(args))
+            step = _round_step(cfg.align, cfg.max_ins_per_col, tmax,
+                               self._bp_consts())
+            return step(*self._shard_args(args, P))
+
+        def finish(idxs, key, out):
+            P, qmax, tmax = key
             out = tuple(np.asarray(o) for o in out)
             if self._mesh is None:
                 (cons, ins_base, ins_votes, ncov, nwin, bp,
@@ -676,6 +854,16 @@ class BatchExecutor:
                     tlen=len(requests[i].draft),
                     bp=int(bp[z]), advance=advance[z],
                 )
+
+        def host_one(i):
+            req = requests[i]
+            return self._sm.round(req.qs, req.qlens, req.row_mask,
+                                  req.draft)
+
+        for (P, qmax, tmax), idxs in groups.items():
+            self._count_cells(requests, idxs, P, qmax,
+                              self._round_z(len(idxs)))
+        self._run_groups(groups, dispatch, finish, host_one, results)
         return results
 
     def _run_refine(self, requests: List[RefineRequest]) -> List[RefineResult]:
@@ -695,25 +883,24 @@ class BatchExecutor:
         if self.metrics is not None:
             self.metrics.windows += len(requests)
             self.metrics.device_dispatches += len(groups)
-        # async-dispatch all groups, then materialize (see _run_rounds)
-        pending = []
-        for (P, qmax, tmax, iters), idxs in groups.items():
+
+        def dispatch(idxs, key):
+            P, qmax, tmax, iters = key
             args = self._stack_group(requests, idxs, P, qmax, tmax)
-            self._count_cells(requests, idxs, P, qmax, args[0].shape[0],
-                              iters)
+            faultinject.fire("device_oom")
             if self._mesh is None:
                 # single device: packed transfer protocol (2 h2d + 2 d2h
                 # latencies per dispatch instead of 5 + 9)
                 step = _refine_step(cfg.align, cfg.max_ins_per_col, tmax,
                                     iters, self._bp_consts(),
                                     pack=(P, qmax))
-                pending.append((idxs, tmax, step(*_pack_args(args))))
-            else:
-                step = _refine_step(cfg.align, cfg.max_ins_per_col, tmax,
-                                    iters, self._bp_consts())
-                pending.append(
-                    (idxs, tmax, step(*self._shard_args(args, P))))
-        for idxs, tmax, out in pending:
+                return step(*_pack_args(args))
+            step = _refine_step(cfg.align, cfg.max_ins_per_col, tmax,
+                                iters, self._bp_consts())
+            return step(*self._shard_args(args, P))
+
+        def finish(idxs, key, out):
+            P, qmax, tmax, iters = key
             out = tuple(np.asarray(o) for o in out)
             if self._mesh is None:
                 (cons, ins_base, ins_votes, ncov, nwin, bp, advance,
@@ -727,9 +914,7 @@ class BatchExecutor:
                 if ovf[z]:
                     if self.metrics is not None:
                         self.metrics.refine_overflows += 1
-                    results[i] = refine_host(
-                        self._sm.round, req.qs, req.qlens, req.row_mask,
-                        req.draft, req.iters)
+                    results[i] = host_one(i)
                     continue
                 rr = RoundResult(
                     cons=cons[z], ins_base=ins_base[z],
@@ -737,6 +922,16 @@ class BatchExecutor:
                     tlen=int(dlen[z]), bp=int(bp[z]), advance=advance[z],
                 )
                 results[i] = RefineResult(rr=rr)
+
+        def host_one(i):
+            req = requests[i]
+            return refine_host(self._sm.round, req.qs, req.qlens,
+                               req.row_mask, req.draft, req.iters)
+
+        for (P, qmax, tmax, iters), idxs in groups.items():
+            self._count_cells(requests, idxs, P, qmax,
+                              self._round_z(len(idxs)), iters)
+        self._run_groups(groups, dispatch, finish, host_one, results)
         return results
 
 
@@ -756,6 +951,7 @@ def _start_hole(hole: _Hole, cfg: CcsConfig) -> None:
     """Start the combined prep+consensus generator (first step only;
     PairRequests and RefineRequests both flow through the driver)."""
     try:
+        faultinject.fire("compute")
         hole.gen = full_gen_for_zmw(hole.zmw, cfg)
         hole.req = next(hole.gen)
     except StopIteration as e:
@@ -773,6 +969,20 @@ def _advance_hole(hole: _Hole, rr) -> None:
         hole.done, hole.req, hole.cns = True, None, _finish(e.value)
     except Exception as e:
         hole.done, hole.req, hole.err = True, None, e
+
+
+def _feed_hole(hole: _Hole, result) -> None:
+    """Route an executor result back into a hole's generator — unless it
+    is an Exception (an executor's last-resort host replay failed for
+    this one request), which quarantines the hole, not the run."""
+    if isinstance(result, Exception):
+        hole.done, hole.req, hole.err = True, None, result
+        try:
+            hole.gen.close()
+        except Exception:
+            pass
+    else:
+        _advance_hole(hole, result)
 
 
 def _finish(result):
@@ -816,6 +1026,7 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
             if h.resumed:
                 next_emit += 1
                 continue
+            wrote = False
             if h.err is not None:
                 metrics.holes_failed += 1
                 print(f"[ccsx-tpu] hole {h.zmw.movie}/{h.zmw.hole} "
@@ -829,7 +1040,10 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
                     else:
                         writer.put(name, seq, qual)
                 metrics.holes_out += 1
-            journal.advance()
+                wrote = True
+            # flush-before-cursor + write fault point + advance: the
+            # shared crash invariant lives in Journal.retire
+            journal.retire(writer, wrote, metrics)
             metrics.tick()
             next_emit += 1
 
@@ -843,6 +1057,7 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
                 try:
                     with metrics.timer("ingest"):
                         z = next(stream)
+                        faultinject.fire("ingest")
                 except StopIteration:
                     exhausted = True
                     break
@@ -878,12 +1093,12 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
                 with metrics.timer("prep"):
                     pres = pair_executor.run([h.req for h in pair_holes])
                     for h, r in zip(pair_holes, pres):
-                        _advance_hole(h, r)
+                        _feed_hole(h, r)
             if round_holes:
                 with metrics.timer("compute"):
                     rres = executor.run([h.req for h in round_holes])
                     for h, rr in zip(round_holes, rres):
-                        _advance_hole(h, rr)
+                        _feed_hole(h, rr)
             still: List[_Hole] = []
             for h in active:
                 if h.done:
@@ -904,6 +1119,9 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
         except OSError as e:
             print(f"Error: write failed! ({e})", file=sys.stderr)
             rc = 1
+        # settle the (possibly rate-limit-lagging) cursor AFTER the
+        # writer has made the records durable
+        journal.close()
         metrics.report()
     return rc
 
@@ -946,10 +1164,14 @@ def run_pipeline_batched(in_path: str, out_path: str, cfg: CcsConfig,
     if mesh_precheck(cfg):
         return 1
 
-    journal = Journal.load_or_create(journal_path, input_id=in_path)
+    # load under this run's fingerprint + reconcile the output tail with
+    # the cursor (truncate a torn tail / refuse an untrustworthy resume)
+    # BEFORE the writer opens for append
+    journal = Journal.for_run(journal_path, in_path, cfg, out_path)
     try:
         writer = open_writer(out_path, append=bool(journal.holes_done),
-                             bam=cfg.bam_out)
+                             bam=cfg.bam_out,
+                             journaled=bool(journal_path))
     except OSError as e:
         print(f"Cannot open file for write! ({e})", file=sys.stderr)
         return 1

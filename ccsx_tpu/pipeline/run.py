@@ -10,6 +10,7 @@ while the writer drains futures strictly in submission order, so output is
 from __future__ import annotations
 
 import collections
+import os
 import sys
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
@@ -19,6 +20,7 @@ from ccsx_tpu.consensus.align_host import HostAligner
 from ccsx_tpu.consensus.hole import ccs_hole
 from ccsx_tpu.io import bam as bam_mod
 from ccsx_tpu.io import fastx, zmw
+from ccsx_tpu.utils import faultinject
 from ccsx_tpu.utils.device import resolve_device
 from ccsx_tpu.utils.journal import Journal
 from ccsx_tpu.utils.metrics import Metrics
@@ -46,28 +48,42 @@ def open_zmw_stream(path: str, cfg: CcsConfig):
 
 
 class _PyWriter:
-    """FASTA/FASTQ writer over a Python file object (stdout / fallback)."""
+    """FASTA/FASTQ writer over a Python file object (stdout / fallback /
+    journaled runs).  Tracks ``bytes_out`` — the exact output size after
+    every record — which the journal records as its torn-tail recovery
+    offset; the shared fastx.format_record counts UTF-8-encoded bytes,
+    not len(str), so a non-ASCII read name (split_name accepts any
+    movie string) cannot skew the offset and mis-truncate a resume."""
 
-    def __init__(self, f, own: bool):
+    def __init__(self, f, own: bool, start_bytes: int = 0):
         self._f = f
         self._own = own
+        self.bytes_out = start_bytes
 
     def put(self, name: str, seq: bytes, qual: bytes | None = None) -> None:
-        if qual is None:
-            self._f.write(f">{name}\n{seq.decode()}\n")
-        else:
-            self._f.write(f"@{name}\n{seq.decode()}\n+\n{qual.decode()}\n")
+        rec, nbytes = fastx.format_record(name, seq, qual)
+        self._f.write(rec)
+        self.bytes_out += nbytes
+
+    def flush(self) -> None:
+        self._f.flush()
 
     def close(self) -> None:
         if self._own:
             self._f.close()
 
 
-def open_writer(path: str, append: bool, bam: bool = False):
+def open_writer(path: str, append: bool, bam: bool = False,
+                journaled: bool = False):
     """Async native writer for real paths; Python writer for stdout;
     buffered BAM writer under --bam.
 
     stdout stays Python-level so redirection (tests, `ccsx-tpu ... -`) works.
+    ``journaled`` runs also use the Python writer: the journal's crash
+    contract needs a synchronous, flushable stream with byte accounting
+    (the record must be durable before the journal cursor claims it),
+    which the async native writer cannot order — and write time is ~0%
+    of wall (ARCHITECTURE.md stage attribution), so nothing is lost.
     """
     from ccsx_tpu import native
 
@@ -78,13 +94,17 @@ def open_writer(path: str, append: bool, bam: bool = False):
             raise OSError("--bam output does not support --journal resume "
                           "(the BGZF container cannot be appended)")
         return bam_mod.BamWriter(path)
-    if path != "-" and native.available():
+    if path != "-" and native.available() and not journaled:
         from ccsx_tpu.native.io import NativeFastaWriter
 
         return NativeFastaWriter(path, append=append)
     if path == "-":
         return _PyWriter(sys.stdout, own=False)
-    return _PyWriter(open(path, "a" if append else "w"), own=True)
+    start = os.path.getsize(path) if append and os.path.exists(path) else 0
+    # UTF-8 pinned (not the locale default) so bytes_out's encode-based
+    # accounting always matches what reaches the file
+    return _PyWriter(open(path, "a" if append else "w", encoding="utf-8"),
+                     own=True, start_bytes=start)
 
 
 def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
@@ -94,11 +114,14 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
     except (OSError, RuntimeError) as e:
         print(f"Error: Failed to open infile! ({e})", file=sys.stderr)
         return 1
-    journal = Journal.load_or_create(journal_path, input_id=in_path)
+    # load under this run's fingerprint + reconcile the output tail
+    # (truncate torn / refuse untrustworthy) before the writer opens
+    journal = Journal.for_run(journal_path, in_path, cfg, out_path)
     resume = journal.holes_done
     try:
         writer = open_writer(out_path, append=bool(resume),
-                             bam=cfg.bam_out)
+                             bam=cfg.bam_out,
+                             journaled=bool(journal_path))
     except OSError as e:
         print(f"Cannot open file for write! ({e})", file=sys.stderr)
         return 1
@@ -110,6 +133,7 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
     def compute(z):
         stats: dict = {}
         try:
+            faultinject.fire("compute")
             return z, ccs_hole(z, aligner, cfg, stats), None, stats
         except Exception as e:  # quarantine: one bad hole must not kill the run
             return z, None, e, stats
@@ -124,6 +148,7 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
         # fused dispatch per shape group)
         metrics.windows += stats.get("windows", 0)
         metrics.device_dispatches += 3 * stats.get("windows", 0)
+        wrote = False
         with metrics.timer("write"):
             if err is not None:
                 metrics.holes_failed += 1
@@ -132,7 +157,10 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
             elif rec is not None and rec[0]:
                 writer.put(f"{z.movie}/{z.hole}/ccs", rec[0], rec[1])
                 metrics.holes_out += 1
-        journal.advance()
+                wrote = True
+        # flush-before-cursor + write fault point + advance: the shared
+        # crash invariant lives in Journal.retire
+        journal.retire(writer, wrote, metrics)
         metrics.tick()
 
     rc = 0
@@ -144,6 +172,7 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
             try:
                 with metrics.timer("ingest"):
                     z = next(stream)
+                    faultinject.fire("ingest")
             except StopIteration:
                 break
             metrics.holes_in += 1
@@ -178,5 +207,8 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
         except OSError as e:
             print(f"Error: write failed! ({e})", file=sys.stderr)
             rc = 1
+        # settle the (possibly rate-limit-lagging) cursor AFTER the
+        # writer has made the records durable
+        journal.close()
         metrics.report()
     return rc

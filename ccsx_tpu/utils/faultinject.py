@@ -1,0 +1,152 @@
+"""Deterministic fault-injection harness (the test surface of the
+fault-tolerance layer; ARCHITECTURE.md "Failure domains").
+
+The reference has no failure story beyond abort-or-soldier-on (SURVEY.md
+§5.5), so there is nothing to inject against; here every recovery path —
+per-hole quarantine, OOM resplit, torn-tail journal recovery — must be
+provable on CPU in CI, which requires failures that fire on demand and on
+a deterministic schedule.
+
+Arming: the ``CCSX_FAULTS`` env var or the ``--inject-faults`` CLI flag,
+with a comma-separated spec of ``point@N`` entries:
+
+    CCSX_FAULTS="device_oom@1,write@3"
+
+``point@N`` fires on the Nth call of that point (once); ``point@N+``
+fires on every call from the Nth on; bare ``point`` means ``point@1``.
+Schedules are call-count based, so a given input + spec reproduces the
+same failure every run.
+
+Points and their actions (each placed at ONE spot in the pipeline):
+
+  ingest      raise ValueError at the stream read — the drivers' clean
+              rc=1 invalid-input path, no traceback
+  compute     raise RuntimeError inside a hole's consensus step — the
+              per-hole quarantine path (one bad hole never kills a run)
+  device_oom  raise RuntimeError("RESOURCE_EXHAUSTED...") at a
+              BatchExecutor device dispatch — the OOM resplit/fallback
+              ladder (pipeline/batch.py)
+  write       hard process exit (os._exit) after a record is written and
+              flushed but BEFORE the journal advances — the torn-tail
+              crash the journal v2 resume must repair
+  journal     hard process exit inside a journal DISK update, after the
+              tmp journal is fsynced but BEFORE the atomic replace —
+              proves the journal update itself is atomic.  Disk updates
+              are rate-limited (utils/journal.py fsync_interval_s); set
+              CCSX_JOURNAL_FSYNC_S=0 for a deterministic per-advance
+              schedule
+
+The hard exits use ``os._exit`` (no atexit, no finally blocks, writer
+not closed) to model SIGKILL as closely as a same-process mechanism can.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional
+
+POINTS = ("ingest", "compute", "device_oom", "write", "journal")
+
+# exit code of the write/journal crash actions — distinctive, so a test
+# (or an operator) can tell an injected kill from a real failure
+EXIT_CODE = 57
+
+_UNSET = object()
+# point -> [fire_at_call, repeat(bool)]; None = disarmed; _UNSET = not
+# yet initialized from the environment
+_plan = _UNSET
+_calls: Dict[str, int] = {}
+# fire() runs on worker threads too (run_pipeline -j>1 computes holes on
+# a pool): the call counter must be atomic or an @N schedule can be
+# skipped under a racy read-modify-write
+_lock = threading.Lock()
+
+
+def parse_spec(spec: str) -> dict:
+    """``"point@N[+],..."`` -> {point: [n, repeat]}; ValueError on junk."""
+    plan = {}
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        point, _, at = item.partition("@")
+        repeat = at.endswith("+")
+        n = at[:-1] if repeat else at
+        if point not in POINTS:
+            raise ValueError(
+                f"unknown fault point {point!r} (choose from {POINTS})")
+        try:
+            nth = int(n) if n else 1
+        except ValueError:
+            raise ValueError(f"bad fault schedule {item!r}: expected "
+                             "point@N or point@N+") from None
+        if nth < 1:
+            raise ValueError(f"fault schedule {item!r}: N must be >= 1")
+        plan[point] = [nth, repeat]
+    return plan
+
+
+def arm(spec: Optional[str]) -> None:
+    """Arm (or with a falsy spec, disarm) the harness; resets call counts."""
+    global _plan
+    _plan = parse_spec(spec) if spec else None
+    _calls.clear()
+
+
+def disarm() -> None:
+    arm(None)
+
+
+def armed(point: Optional[str] = None) -> bool:
+    _ensure_init()
+    if _plan is None:
+        return False
+    return point in _plan if point else bool(_plan)
+
+
+def _ensure_init() -> None:
+    # lazy env arming keeps import free of side effects and lets the CLI
+    # flag override the environment (arm() is explicit).  A malformed
+    # CCSX_FAULTS must fail ATTRIBUTED to the env var, not surface as a
+    # ValueError inside whatever pipeline stage fired first (where the
+    # drivers would misreport it as an input-stream error) — so it
+    # escalates to SystemExit, which no recovery layer swallows.
+    global _plan
+    if _plan is _UNSET:
+        try:
+            _plan = parse_spec(os.environ.get("CCSX_FAULTS", "")) or None
+        except ValueError as e:
+            _plan = None
+            raise SystemExit(f"Error: CCSX_FAULTS: {e}") from None
+
+
+def fire(point: str) -> None:
+    """Injection point hook: a no-op unless this point is armed and its
+    schedule says this call is the one.  Raises/exits per the point's
+    documented action."""
+    _ensure_init()
+    if _plan is None or point not in _plan:
+        return
+    with _lock:
+        _calls[point] = n = _calls.get(point, 0) + 1
+    fire_at, repeat = _plan[point]
+    if n != fire_at and not (repeat and n >= fire_at):
+        return
+    import sys
+
+    print(f"[ccsx-tpu] faultinject: firing {point!r} (call {n})",
+          file=sys.stderr)
+    if point == "ingest":
+        raise ValueError(f"injected ingest fault (faultinject, call {n})")
+    if point == "compute":
+        raise RuntimeError(
+            f"injected compute fault (faultinject, call {n})")
+    if point == "device_oom":
+        raise RuntimeError(
+            "RESOURCE_EXHAUSTED: injected device OOM "
+            f"(faultinject, call {n})")
+    # write / journal: simulated SIGKILL — flush the injection notice,
+    # then exit without running any cleanup
+    sys.stderr.flush()
+    os._exit(EXIT_CODE)

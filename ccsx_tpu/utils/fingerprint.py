@@ -1,0 +1,72 @@
+"""Config/code fingerprints for resume-compatibility checks.
+
+A checkpoint (the run journal, utils/journal.py v2; the quality bench's
+``.partial`` artifact, benchmarks/quality.py) is only resumable into a
+run that would have produced byte-identical output — resuming across a
+consensus-code or consensus-config change silently mixes old-code
+sections into an artifact that claims the new code.  Both consumers pin
+these fingerprints and refuse (recompute from scratch) on mismatch.
+
+``code_fingerprint`` hashes the consensus-critical sources directly
+(config + consensus/ops/pipeline modules) rather than reading git HEAD:
+uncommitted edits must invalidate a checkpoint too, and the hash needs
+no git binary or repository to work from an installed tree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import hashlib
+import json
+import os
+
+# modules whose behavior shapes consensus OUTPUT bytes.  io/parallel are
+# deliberately out: how bytes are parsed in or sharded across hosts is
+# pinned byte-identical by tests, and including them would invalidate
+# checkpoints on changes that cannot alter output.
+_SRC_DIRS = ("consensus", "ops", "pipeline")
+
+# CcsConfig fields that tile/observe but never change output bytes
+# (bucketing is masked padding — pinned by
+# test_pass_buckets_knob_output_invariant — and backend choice is
+# bit-identical by the differential suite)
+_NON_SEMANTIC = frozenset({
+    "threads", "verbose", "device", "mesh_shape", "metrics_path",
+    "pass_buckets", "zmw_microbatch", "chunk_size", "chunk_growth",
+    "chunk_cap",
+})
+
+
+@functools.lru_cache(maxsize=1)
+def code_fingerprint() -> str:
+    """Short stable hash of the consensus-critical source files."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = [os.path.join(root, "config.py")]
+    for d in _SRC_DIRS:
+        dd = os.path.join(root, d)
+        paths += [os.path.join(dd, f) for f in sorted(os.listdir(dd))
+                  if f.endswith(".py")]
+    h = hashlib.sha256()
+    for p in paths:
+        h.update(os.path.relpath(p, root).encode())
+        with open(p, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def config_fingerprint(cfg) -> str:
+    """Short hash of the output-shaping fields of a CcsConfig."""
+    d = dataclasses.asdict(cfg)
+    for k in _NON_SEMANTIC:
+        d.pop(k, None)
+    if d.get("exclude_holes") is not None:
+        d["exclude_holes"] = sorted(d["exclude_holes"])
+    blob = json.dumps(d, sort_keys=True, default=repr)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def run_fingerprint(cfg) -> str:
+    """The journal v2 compatibility key: code + config, either mismatch
+    refuses a resume."""
+    return f"{code_fingerprint()}-{config_fingerprint(cfg)}"

@@ -26,6 +26,13 @@ class Metrics:
     pair_alignments: int = 0   # batched prep strand_match pairs
     device_dispatches: int = 0
     refine_overflows: int = 0  # fused windows replayed on host (rare)
+    # fault-tolerance ladder counters (pipeline/batch.py recovery):
+    # group bisections after a device OOM, per-request host replays
+    # (ladder bottom / data errors), and scan-spec pins after a Pallas
+    # compile failure (at most 1/process)
+    oom_resplits: int = 0
+    host_fallbacks: int = 0
+    compile_fallbacks: int = 0
     # padding accounting for the batched device rounds (SURVEY §7.3
     # item 2 names padding waste the main throughput risk): real = DP
     # fill cells belonging to real pass-rows at their true qlen;
@@ -107,6 +114,9 @@ class Metrics:
             "pair_alignments": self.pair_alignments,
             "device_dispatches": self.device_dispatches,
             "refine_overflows": self.refine_overflows,
+            "oom_resplits": self.oom_resplits,
+            "host_fallbacks": self.host_fallbacks,
+            "compile_fallbacks": self.compile_fallbacks,
             "dp_cells_real": self.dp_cells_real,
             "dp_cells_padded": self.dp_cells_padded,
             "dp_occupancy": round(self.dp_cells_real

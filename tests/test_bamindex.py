@@ -127,6 +127,9 @@ def test_merge_refuses_mixed_modes(tmp_path):
                              start_ordinal=start)
         w.put_at(0, f"mv/{r}/ccs", b"ACGT")
         w.close()
+        # completion markers, so the mode check (not the dead-shard
+        # refusal, tests/test_faults.py) is what this exercises
+        dist._write_done_marker(str(tmp_path / "o.fa"), r, 2, 1)
     with pytest.raises(ValueError, match="sharding mode"):
         dist.merge_shards(str(tmp_path / "o.fa"), 2)
 

@@ -32,12 +32,17 @@ def _work(n):
 
 def test_positive_and_scales_with_workload():
     x = np.arange(1 << 16, dtype=np.int32)
-    light = min(marginal_time(_work(4), x, iters=40, repeats=3))
-    heavy = min(marginal_time(_work(400), x, iters=40, repeats=3))
+    # min over 5 windows (not 3) and a 2x (not 3x) separation: on a
+    # contended 1-core CI host a single noisy light-window can inflate
+    # `light` enough to flake the tighter bound, while a genuine
+    # lazy-runtime regression (both readings ~the fixed RPC latency)
+    # still fails 2x by an order of magnitude
+    light = min(marginal_time(_work(4), x, iters=40, repeats=5))
+    heavy = min(marginal_time(_work(400), x, iters=40, repeats=5))
     assert light > 0 and heavy > 0
     # 100x the elementwise chain must cost measurably more per call —
     # the property the lazy runtime's fake timings violated
-    assert heavy > 3 * light, (light, heavy)
+    assert heavy > 2 * light, (light, heavy)
 
 
 def test_refuses_when_no_positive_sample():
