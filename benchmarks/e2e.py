@@ -83,8 +83,10 @@ def run_config(config: int, n_holes: int, batch: str, seed: int = 0) -> dict:
     with tempfile.TemporaryDirectory() as tmp:
         in_path, args, zs = make_input(config, n_holes, rng, tmp)
         out = os.path.join(tmp, "out.fa")
+        mpath = os.path.join(tmp, "m.jsonl")
         t0 = time.perf_counter()
-        rc = cli.main([*args, "--batch", batch, in_path, out])
+        rc = cli.main([*args, "--batch", batch, "--metrics", mpath,
+                       in_path, out])
         dt = time.perf_counter() - t0
         assert rc == 0, f"config {config}: rc={rc}"
         got = {r.name: r.seq for r in fastx.read_fastx(out)}
@@ -94,6 +96,9 @@ def run_config(config: int, n_holes: int, batch: str, seed: int = 0) -> dict:
             if k in got:
                 idys.append(synth.identity_either(
                     enc.encode(got[k]), z.template))
+        with open(mpath) as f:
+            lines = f.read().splitlines()
+        final = json.loads(lines[-1]) if lines else {}
         import jax
 
         return {
@@ -104,6 +109,11 @@ def run_config(config: int, n_holes: int, batch: str, seed: int = 0) -> dict:
             "holes_out": len(got),
             "seconds": round(dt, 3),
             "zmws_per_sec": round(len(got) / dt, 3),
+            # ragged pass-packing occupancy (batched runs; None under
+            # --batch off or the bucketed control)
+            "dp_row_fill": final.get("dp_row_fill"),
+            "packed_holes_per_dispatch": final.get(
+                "packed_holes_per_dispatch"),
             "mean_identity": round(float(np.mean(idys)), 5) if idys else None,
         }
 

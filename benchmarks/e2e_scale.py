@@ -138,6 +138,12 @@ def run_scale(n_holes: int, inflight: int, rng, device: str = "auto",
             "dp_length_fill": final["dp_length_fill"],
             "dp_pass_fill": final["dp_pass_fill"],
             "dp_z_fill": final["dp_z_fill"],
+            # ragged pass-packing counters (None on the --pass-buckets
+            # bucketed control): real rows / slab rows dispatched, and
+            # holes co-dispatched per slab
+            "dp_row_fill": final.get("dp_row_fill"),
+            "packed_holes_per_dispatch": final.get(
+                "packed_holes_per_dispatch"),
             "stage_seconds": {k: final[k] for k in
                               ("ingest_s", "prep_s", "compute_s",
                                "write_s")},
@@ -159,8 +165,11 @@ def main():
                     help="template length range lo,hi (smoke runs can "
                          "shrink this)")
     ap.add_argument("--pass-buckets", default=None,
-                    help="forwarded to the CLI (occupancy/grouping "
-                         "tuning A/B)")
+                    help="forwarded to the CLI: selects the BUCKETED "
+                         "grouping control (disables pass packing)")
+    ap.add_argument("--slab-rows", type=int, default=None,
+                    help="forwarded to the CLI: pass-packing slab row "
+                         "budget")
     ap.add_argument("--json", default=None)
     a = ap.parse_args()
     tlen_lo, tlen_hi = (int(x) for x in a.tlen.split(","))
@@ -176,6 +185,9 @@ def main():
              if a.pass_buckets else ())
     if a.pass_buckets:
         res["pass_buckets"] = a.pass_buckets
+    if a.slab_rows:
+        extra = extra + ("--slab-rows", str(a.slab_rows))
+        res["slab_rows"] = a.slab_rows
     res["scale"] = run_scale(a.holes, a.inflight, rng, a.device,
                              tlen_lo, tlen_hi, extra)
     if not a.skip_round:

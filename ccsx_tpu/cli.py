@@ -44,7 +44,9 @@ TPU extensions (long options):
 --hosts <int> --host-id <int> --coordinator <addr> --merge-shards <N>
 --merge-unmarked          (merge a legacy shard set without .done markers)
 --make-index              (index INPUT for byte-range sharded ingest)
---pass-buckets a,b,...    (device pass-padding buckets; default 4,8,16,32)
+--slab-rows <int>         (ragged pass-packing row budget; default 128)
+--pass-buckets a,b,...    (bucketed-grouping A/B control: disables pass
+                           packing and pads passes to these buckets)
 --inject-faults p@N,...   (deterministic fault injection; testing only)
 """
 
@@ -93,9 +95,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--refine-iters", type=int, default=2)
     p.add_argument("--max-passes", type=int, default=32)
     p.add_argument("--pass-buckets", default=None, metavar="A,B,...",
-                   help="device pass-padding buckets (ascending ints; "
-                        "the occupancy/grouping tuning knob — "
-                        "ARCHITECTURE.md perf notes)")
+                   help="bucketed-grouping A/B control: DISABLES ragged "
+                        "pass packing and pads passes to these buckets "
+                        "(ascending ints; ARCHITECTURE.md perf notes). "
+                        "Output is byte-identical either way")
+    p.add_argument("--slab-rows", type=int, default=None, metavar="R",
+                   help="pass-packing slab row budget (power of two; "
+                        "rows from many holes share one (R, qmax) "
+                        "dispatch) [128]")
     p.add_argument("--fastq", action="store_true", dest="fastq",
                    help="Write FASTQ with per-base vote-margin qualities "
                         "instead of FASTA (extension; the reference "
@@ -189,6 +196,11 @@ def config_from_args(args) -> CcsConfig:
                   f"{pass_buckets[-1]} must cover --max-passes "
                   f"{args.max_passes}", file=sys.stderr)
             raise SystemExit(1)
+    slab_rows = getattr(args, "slab_rows", None)
+    if slab_rows is not None and slab_rows < 1:
+        print(f"Error: --slab-rows must be >= 1, got {slab_rows}",
+              file=sys.stderr)
+        raise SystemExit(1)
     return CcsConfig(
         min_subread_len=args.min_len,
         max_subread_len=args.max_len,
@@ -206,7 +218,11 @@ def config_from_args(args) -> CcsConfig:
         mesh_shape=mesh_shape,
         device=args.device,
         metrics_path=args.metrics,
+        # an explicit bucket list selects the bucketed-grouping control
+        # path; the default is ragged pass packing (pipeline/pack.py)
+        pass_packing=pass_buckets is None,
         **({"pass_buckets": pass_buckets} if pass_buckets else {}),
+        **({"slab_rows": slab_rows} if slab_rows else {}),
     )
 
 

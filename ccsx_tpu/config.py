@@ -125,8 +125,19 @@ class CcsConfig:
 
     # ---- TPU tiling ----
     pass_buckets: tuple = (4, 8, 16, 32)   # passes padded to the next bucket
+    #   (request/tensor shapes, the per-hole path, the mesh path, and the
+    #   --pass-buckets bucketed A/B control; the packed batched path
+    #   strips this padding back off before dispatch)
+    pass_packing: bool = True          # batched pipeline: pack (hole, pass)
+    #   rows into fixed (slab_rows, qmax) slabs (pipeline/pack.py) instead
+    #   of grouping by pass bucket — kills pass-bucket and partial-Z
+    #   padding at byte-identical output.  CLI --pass-buckets selects the
+    #   bucketed control; a device mesh also keeps the bucketed layout
     max_passes: int = 32               # extra passes beyond this are dropped (deepest
     #   passes add negligible consensus signal; reference keeps all — documented delta)
+    slab_rows: int = 128               # packed-slab row budget (power of two;
+    #   the Z-bucket analog for packed dispatches — tail slabs shrink down
+    #   the same pow2 ladder, so compile count stays logarithmic)
     zmw_microbatch: int = 64           # ZMWs per device dispatch
     len_bucket_quant: int = 512        # whole-read mode: lengths padded to multiple
 

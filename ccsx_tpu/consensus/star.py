@@ -167,9 +167,16 @@ class RefineRequest:
 
     The per-hole path satisfies it with the host loop (refine_host — the
     spec); the batched pipeline runs it as ONE fused device dispatch
-    (pipeline/batch._refine_step) whose intermediate speculative drafts
-    never leave the chip — the dominant dispatch-count reduction of the
-    framework (one launch per window instead of iters+1)."""
+    whose intermediate speculative drafts never leave the chip — the
+    dominant dispatch-count reduction of the framework (one launch per
+    window instead of iters+1).  By default the executor strips the
+    pass-bucket padding back off and packs only the row_mask rows into a
+    shared slab with other holes' rows (pipeline/pack.py +
+    batch._refine_step_packed); the (P, qmax) request shape with its
+    padded rows is still what the host replay, the bucketed
+    --pass-buckets control (batch._refine_step), and the --mesh
+    shardings consume, and the result's ``advance`` always comes back in
+    this request's (P,) pass order whichever executor ran."""
 
     qs: np.ndarray        # (P, qmax) uint8 padded passes
     qlens: np.ndarray     # (P,) int32

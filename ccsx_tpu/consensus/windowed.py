@@ -163,7 +163,11 @@ def windowed_gen(passes: List[np.ndarray], cfg: CcsConfig):
                 bp = max(rr.tlen - cfg.bp_window, 1)
             emit(rr, upto=bp)
             if rr.advance is not None:
-                # device advance was computed at this same bp_eff
+                # device advance was computed at this same bp_eff, and
+                # arrives in THIS request's (P,) pass order whichever
+                # executor ran (the pass-packed path scatters its
+                # per-row advances back through row_mask; a masked row
+                # consumed nothing, matching the fixed-P path's 0)
                 pos += rr.advance[:nseq].astype(np.int64)
             else:
                 pos += _advance(rr, bp)[:nseq]  # drop pass-bucket padding

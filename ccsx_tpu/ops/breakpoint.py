@@ -17,6 +17,80 @@ import jax
 import jax.numpy as jnp
 
 
+def make_bp_advance_packed(tmax: int, num_segments: int, bp_window: int,
+                           bp_minwin: int, bp_rowrate: int,
+                           bp_colrate: int, bp_colrate_lowpass: int):
+    """Segment-id breakpoint + advance for the ragged pass-packed slabs
+    (pipeline/pack.py): rows of MANY holes share one slab, ``seg`` maps
+    row -> hole slot.
+
+    Inputs: match (R, tmax) bool, cons (H, tmax) uint8, aligned (R,
+    tmax) uint8, ins_cnt (R, tmax) int32, lead_ins (R,) int32, row_mask
+    (R,) bool, seg (R,) int32 sorted, tlen (H,) int32.
+
+    Returns (bp (H,), advance (R,)): bp as in make_bp_advance, per hole
+    slot; advance per ROW — each row's query bases consumed by columns
+    [0, bp_eff(seg[row])), which the executor scatters back into the
+    request's (P,) pass order (masked pass rows consumed nothing, so
+    their 0 matches the fixed-P path's computed 0).
+
+    Bit-parity with make_bp_advance per hole: every per-hole count is a
+    masked integer segment sum over exactly the hole's real rows, the
+    per-row window sums are unchanged, and the all-rows gate becomes
+    "zero masked violations in the segment" — the same predicate the
+    fixed-P version evaluates with padding rows forced to pass.  An
+    empty hole slot has cons == GAP everywhere (segment vote), so
+    isbase is all-False and bp = -1.
+    """
+    W = bp_window
+    H = num_segments
+
+    def f(match, cons, aligned, ins_cnt, lead_ins, row_mask, seg, tlen):
+        tlen = jnp.asarray(tlen, jnp.int32)
+        col = jnp.arange(tmax, dtype=jnp.int32)
+
+        def ssum(x):
+            return jax.ops.segment_sum(x.astype(jnp.int32), seg,
+                                       num_segments=H,
+                                       indices_are_sorted=True)
+
+        incols = col[None, :] < tlen[:, None]                 # (H, tmax)
+        nseq = ssum(row_mask)                                 # (H,)
+        isbase = (cons < 4) & incols
+        matchcnt = ssum(match)                                # (H, tmax)
+        colrate = jnp.where(nseq >= 10, bp_colrate, bp_colrate_lowpass)
+        colok = matchcnt * 100 >= colrate[:, None] * nseq[:, None]
+        badbase = isbase & ~colok
+
+        def wsum(x):
+            c = jnp.cumsum(x.astype(jnp.int32), axis=-1)
+            pad = jnp.zeros(x.shape[:-1] + (1,), jnp.int32)
+            c = jnp.concatenate([pad, c], axis=-1)
+            return c[..., W:] - c[..., :-W]
+
+        nog = wsum(isbase)                                    # (H, ...)
+        bad = wsum(badbase)
+        rowin = wsum(match & isbase[seg])                     # (R, ...)
+        # every real row of the hole must match in >= rowrate% of the
+        # window's base columns: count masked violations per segment
+        viol = (rowin * 100 < bp_rowrate * nog[seg]) & row_mask[:, None]
+        rows_ok = ssum(viol) == 0
+        idx = jnp.arange(tmax - W + 1, dtype=jnp.int32)
+        valid = (bad == 0) & (nog >= bp_minwin) \
+            & isbase[:, : tmax - W + 1] & rows_ok
+        valid &= (idx[None, :] >= 1) & (idx[None, :] <= (tlen - W)[:, None])
+        bp = jnp.where(valid, idx[None, :], -1).max(axis=1)
+
+        bp_eff = jnp.where(bp >= 1, bp, jnp.maximum(tlen - W, 1))
+        ccols = col[None, :] < bp_eff[seg][:, None]           # (R, tmax)
+        nongap = ((aligned < 4) & ccols).sum(1)
+        ins = (ins_cnt * ccols).sum(1)
+        advance = (nongap + ins).astype(jnp.int32) + lead_ins
+        return bp.astype(jnp.int32), advance
+
+    return f
+
+
 def make_bp_advance(tmax: int, bp_window: int, bp_minwin: int,
                     bp_rowrate: int, bp_colrate: int,
                     bp_colrate_lowpass: int):

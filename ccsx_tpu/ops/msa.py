@@ -59,6 +59,65 @@ def make_voter(max_ins: int = 4):
     return vote
 
 
+def make_segment_voter(max_ins: int, num_segments: int):
+    """Segment-id column vote for the ragged pass-packed pipeline
+    (pipeline/pack.py): rows from MANY holes share one (R, T) slab, and
+    ``seg`` maps each row to its hole slot in [0, num_segments).
+
+    Shapes: aligned (R, T), ins_cnt (R, T), ins_b (R, T, max_ins),
+    row_mask (R,) bool, seg (R,) int32 SORTED ascending (pack.segment_ids
+    guarantees it; padding rows carry an in-range id and are masked).
+    Returns the same tuple as make_voter with the hole axis H =
+    num_segments in front of the per-hole outputs and match staying
+    per-ROW:
+      cons (H, T), ins_base (H, T, max_ins), ins_votes (H, T, max_ins),
+      ncov (H, T), match (R, T), nwin (H, T).
+
+    Bit-parity with make_voter per hole: every reduced quantity is
+    pre-masked by row_mask before the segment sum, so a hole's counts
+    are the integer sums over exactly its real rows — the same sums the
+    fixed-P vote takes over a (P, T) block with padding rows masked —
+    and argmax tie-breaking is the same first-max over the stacked base
+    axis.  Empty hole slots get ncov == 0 -> cons GAP, like an all-pad
+    block.  Integer scatter-adds are order-invariant, so the reduction
+    order change cannot perturb results.
+    """
+    H = num_segments
+
+    @jax.jit
+    def vote(aligned, ins_cnt, ins_b, row_mask, seg):
+        mask = row_mask[:, None]
+
+        def ssum(x):
+            return jax.ops.segment_sum(x.astype(jnp.int32), seg,
+                                       num_segments=H,
+                                       indices_are_sorted=True)
+
+        cnts = jnp.stack(
+            [ssum((aligned == c) & mask) for c in range(5)]
+        )  # (5, H, T): A C G T gap
+        ncov = cnts.sum(0)
+        nwin = cnts.max(0)
+        cons = jnp.argmax(cnts, axis=0).astype(jnp.uint8)
+        cons = jnp.where(ncov == 0, jnp.uint8(GAP), cons)
+
+        bases, votes = [], []
+        for r in range(max_ins):
+            has = mask & (ins_cnt > r)
+            votes.append(ssum(has))
+            bc = jnp.stack(
+                [ssum((ins_b[:, :, r] == c) & has) for c in range(4)]
+            )
+            bases.append(jnp.argmax(bc, axis=0).astype(jnp.uint8))
+        ins_base = jnp.stack(bases, axis=2)
+        ins_votes = jnp.stack(votes, axis=2)
+
+        match = (aligned == cons[seg]) & mask
+        return cons, ins_base, ins_votes, ncov, match, nwin
+
+    return vote
+
+
 def emit_insertions(ins_base: np.ndarray, ins_votes: np.ndarray,
                     ncov: np.ndarray, speculative: bool) -> np.ndarray:
     """Decide which insertion cells become columns (host, NumPy).

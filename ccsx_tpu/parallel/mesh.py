@@ -108,19 +108,24 @@ def make_sharded_round(mesh: Mesh, params: AlignParams, tmax: int,
         ins_votes = jnp.stack(votes, axis=2)
         return cons, ins_base, ins_votes, ncov, nwin
 
-    shard = jax.shard_map(
-        local_round,
-        mesh=mesh,
-        in_specs=(P("data", "pass", None), P("data", "pass"),
-                  P("data", None), P("data"), P("data", "pass")),
-        out_specs=(P("data", None), P("data", None, None),
-                   P("data", None, None), P("data", None),
-                   P("data", None)),
-        # the DP scan carry mixes replicated init constants with varying
-        # values; skip the vma consistency check rather than pcast every
-        # carry component
-        check_vma=False,
-    )
+    in_specs = (P("data", "pass", None), P("data", "pass"),
+                P("data", None), P("data"), P("data", "pass"))
+    out_specs = (P("data", None), P("data", None, None),
+                 P("data", None, None), P("data", None),
+                 P("data", None))
+    # the DP scan carry mixes replicated init constants with varying
+    # values; skip the varying-manual-axes consistency check rather than
+    # pcast every carry component.  jax.shard_map (with check_vma) only
+    # exists from jax 0.6; on the 0.4.x line the same entry point is
+    # jax.experimental.shard_map with the check named check_rep.
+    if hasattr(jax, "shard_map"):
+        shard = jax.shard_map(local_round, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=False)
+    else:
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        shard = _shard_map(local_round, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_rep=False)
     return jax.jit(shard)
 
 

@@ -9,12 +9,19 @@ executes their pending RefineRequests together:
   admit holes ──> per-hole generator (host state machine)
                     │ yields RefineRequest (one window's refinement)
                     ▼
-  group by (P, qmax, tmax, iters) shape bucket ──> stack to (Z, P, qmax)
+  group by (qmax, tmax, iters) ──> flatten each hole's passes into
+  (hole, pass) ROWS and pack rows from many holes into fixed (R, qmax)
+  slabs, first-fit-decreasing by hole (pipeline/pack.py); a row->hole
+  segment-id vector rides along.  [--pass-buckets restores the older
+  (P, qmax, tmax, iters) bucketed grouping as the A/B control, and a
+  device mesh keeps it — the (data, pass) shardings need the fixed
+  (Z, P) layout.]
                     ▼
-  ONE fused jitted dispatch per group (_refine_step): the speculative
-  refinement rounds loop on device (banded DP fill + traceback
-  projection + column vote + draft re-materialization), then the final
-  round + breakpoint scan — intermediate drafts never leave the chip
+  ONE fused jitted dispatch per slab (_refine_step_packed; _refine_step
+  for the bucketed control): the speculative refinement rounds loop on
+  device (banded DP fill + traceback projection + segment-id column
+  vote + draft re-materialization), then the final round + breakpoint
+  scan — intermediate drafts never leave the chip
                     ▼
   RefineResults routed back into each generator; finished holes emit
   consensus to the order-preserving writer.
@@ -48,6 +55,7 @@ from ccsx_tpu.consensus.star import (
 from ccsx_tpu.ops import banded
 from ccsx_tpu.ops import encode as enc
 from ccsx_tpu.ops import traceback
+from ccsx_tpu.pipeline import pack as pack_mod
 from ccsx_tpu.utils import faultinject
 from ccsx_tpu.utils.journal import Journal
 from ccsx_tpu.utils.metrics import Metrics
@@ -490,6 +498,196 @@ def _unpack_refine(big, small, max_ins: int, tmax: int):
             rest[:, -2], rest[:, -1] != 0)
 
 
+# ---- ragged pass-packed dispatch (pipeline/pack.py plans the slabs;
+# ---- these are the device steps and the slab transfer protocol) ----------
+
+@functools.lru_cache(maxsize=128)
+def _round_body_packed(params: AlignParams, max_ins: int, tmax: int,
+                       nseg: int):
+    """One star round over a packed slab: (R, qmax) rows from up to
+    ``nseg`` holes, each row aligned to ITS hole's draft (a per-row
+    gather replaces the bucketed path's per-hole broadcast), voted by
+    segment id (msa.make_segment_voter).  Per-row alignment and
+    projection are the same pure functions as _round_body's, so a row's
+    tensors do not depend on which slab it rides in — the keystone of
+    the packed path's byte-identity."""
+    from ccsx_tpu.consensus import star as star_mod
+    from ccsx_tpu.ops import msa as msa_mod
+
+    aligner = star_mod._aligner(params)  # scan default; env-gated Pallas
+    projector = traceback.make_projector(tmax, max_ins)
+    voter = msa_mod.make_segment_voter(max_ins, nseg)
+
+    def body(qs, qlens, row_mask, seg, draft, dlen):
+        ts_r = draft[seg]          # (R, tmax) per-row targets
+        tl_r = dlen[seg]           # (R,)
+        _, moves, offs = aligner(qs, qlens, ts_r, tl_r)
+        proj = jax.vmap(projector, in_axes=(0, 0, 0, 0, 0))
+        aligned, ins_cnt, ins_b, lead_ins = proj(
+            moves, offs, qs, qlens, tl_r)
+        cons, ins_base, ins_votes, ncov, match, nwin = voter(
+            aligned, ins_cnt, ins_b, row_mask, seg)
+        return (cons, ins_base, ins_votes, ncov, nwin, match, aligned,
+                ins_cnt, lead_ins)
+
+    return body
+
+
+@functools.lru_cache(maxsize=128)
+def _refine_step_packed(params: AlignParams, max_ins: int, tmax: int,
+                        iters: int, nseg: int, bp_consts: tuple,
+                        pack: tuple | None = None):
+    """The fused whole-window refinement loop over ONE packed slab —
+    _refine_step's ragged twin.  The while_loop carries per-SEGMENT
+    (hole-slot) fixpoint state instead of per-Z-slot state: hole-shaped
+    carries (draft/dlen/fixed/ovf and the vote outputs) are (H, ...)
+    with H = nseg, the per-row tensors the post-loop breakpoint needs
+    are (R, ...), and freezing broadcasts hole state onto rows through
+    the segment vector.  Same fixpoint/overflow semantics as the
+    bucketed step (which tests pin against refine_host, the spec).
+
+    pack=(R, qmax) selects the transfer-packed single-device variant:
+    the 6 slab inputs ride ONE 1-D uint8 + ONE 1-D int32 buffer and the
+    9 outputs one of each (see _pack_slab_args; rationale in
+    _round_step).  The packed path runs only without a device mesh, so
+    unlike _refine_step there is no sharded multi-array variant."""
+    import jax.numpy as jnp
+
+    from ccsx_tpu.ops import breakpoint as bp_mod
+    from ccsx_tpu.ops import msa as msa_mod
+
+    one_round = _round_body_packed(params, max_ins, tmax, nseg)
+    bp_advance = bp_mod.make_bp_advance_packed(tmax, nseg, *bp_consts)
+    mat_v = jax.vmap(msa_mod.make_materializer(tmax, tmax, max_ins))
+    spec_emit = jax.vmap(
+        lambda ib, iv, nc: msa_mod.emit_insertions_jax(ib, iv, nc, True))
+    H = nseg
+
+    def core(qs, qlens, row_mask, seg, ts, tlens):
+        R = qs.shape[0]
+
+        def body(carry):
+            it, draft, dlen, fixed, ovf, outs = carry
+            new = one_round(qs, qlens, row_mask, seg, draft, dlen)
+            # frozen holes keep their LAST live round's outputs (same
+            # final-round folding as _refine_step); outs[:5] are
+            # hole-shaped, outs[5:] row-shaped — rows freeze with their
+            # hole via the segment gather
+            fix_r = fixed[seg]
+            outs = tuple(
+                jnp.where(fixed.reshape((H,) + (1,) * (n.ndim - 1)), o, n)
+                for o, n in zip(outs[:5], new[:5])
+            ) + tuple(
+                jnp.where(fix_r.reshape((R,) + (1,) * (n.ndim - 1)), o, n)
+                for o, n in zip(outs[5:], new[5:])
+            )
+            cons, ins_base, ins_votes, ncov = outs[:4]
+            ins_out = spec_emit(ins_base, ins_votes, ncov)
+            nd, nl, o = mat_v(cons, ins_out, dlen)
+            now_fixed = (nl == dlen) & (nd == draft).all(axis=1)
+            last = it >= iters
+            o = ~fixed & o & ~last
+            grow = ~fixed & ~o & ~now_fixed & ~last
+            draft = jnp.where(grow[:, None], nd, draft)
+            dlen = jnp.where(grow, nl, dlen)
+            return (it + 1, draft, dlen, fixed | now_fixed | o | last,
+                    ovf | o, outs)
+
+        def cond(carry):
+            return ~carry[3].all()
+
+        # empty hole slots (no real rows — slab tail capacity) start
+        # frozen, as pad holes do in _refine_step; the executor never
+        # reads them back
+        nrows = jax.ops.segment_sum(row_mask.astype(jnp.int32), seg,
+                                    num_segments=H,
+                                    indices_are_sorted=True)
+        fixed0 = nrows == 0
+        ovf0 = jnp.zeros((H,), bool)
+        outs0 = (
+            jnp.zeros((H, tmax), jnp.uint8),            # cons
+            jnp.zeros((H, tmax, max_ins), jnp.uint8),   # ins_base
+            jnp.zeros((H, tmax, max_ins), jnp.int32),   # ins_votes
+            jnp.zeros((H, tmax), jnp.int32),            # ncov
+            jnp.zeros((H, tmax), jnp.int32),            # nwin
+            jnp.zeros((R, tmax), bool),                 # match
+            jnp.zeros((R, tmax), jnp.uint8),            # aligned
+            jnp.zeros((R, tmax), jnp.int32),            # ins_cnt
+            jnp.zeros((R,), jnp.int32),                 # lead_ins
+        )
+        _, _, dlen, _, ovf, outs = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), ts, tlens, fixed0, ovf0, outs0))
+        (cons, ins_base, ins_votes, ncov, nwin, match, aligned, ins_cnt,
+         lead_ins) = outs
+        bp, advance = bp_advance(match, cons, aligned, ins_cnt, lead_ins,
+                                 row_mask, seg, dlen)
+        # uint8 vote/coverage compaction, as in _round_step (bounded by
+        # the hole's real row count <= max_passes)
+        return (cons, ins_base, ins_votes.astype(jnp.uint8),
+                ncov.astype(jnp.uint8), nwin.astype(jnp.uint8),
+                bp, advance, dlen, ovf)
+
+    if pack is None:
+        return jax.jit(core)
+    R, qmax = pack
+
+    @jax.jit
+    def step(big, small):
+        args = _unpack_slab_args_jax(big, small, R, qmax, H, tmax)
+        (cons, ins_base, ins_votes, ncov, nwin, bp, advance, dlen,
+         ovf) = core(*args)
+        big_out = jnp.concatenate([
+            cons.reshape(-1), ins_base.reshape(-1),
+            ins_votes.reshape(-1), ncov.reshape(-1), nwin.reshape(-1)])
+        small_out = jnp.concatenate(
+            [bp, dlen, ovf.astype(jnp.int32), advance]).astype(jnp.int32)
+        return big_out, small_out
+
+    return step
+
+
+def _pack_slab_args(args):
+    """Host side of the slab transfer protocol: the 6 packed-refine
+    inputs become one 1-D uint8 and one 1-D int32 buffer (one h2d
+    latency each — same fixed-latency rationale as _pack_args)."""
+    qs, qlens, row_mask, seg, ts, tlens = args
+    big = np.concatenate([qs.reshape(-1), ts.reshape(-1)])
+    small = np.concatenate([qlens, row_mask.astype(np.int32), seg, tlens])
+    return big, small
+
+
+def _unpack_slab_args_jax(big, small, R: int, qmax: int, H: int,
+                          tmax: int):
+    """Device side of _pack_slab_args."""
+    qs = big[:R * qmax].reshape(R, qmax)
+    ts = big[R * qmax:].reshape(H, tmax)
+    qlens = small[:R]
+    row_mask = small[R:2 * R] != 0
+    seg = small[2 * R:3 * R]
+    tlens = small[3 * R:]
+    return qs, qlens, row_mask, seg, ts, tlens
+
+
+def _unpack_slab_refine(big, small, max_ins: int, tmax: int, H: int,
+                        R: int):
+    """Host-side split of a packed-slab refine result back into the
+    9-tuple (cons, ins_base, ins_votes, ncov, nwin, bp, advance, dlen,
+    ovf) — hole-shaped fields (H, ...), advance per row (R,)."""
+    T, M = tmax, max_ins
+    sizes = [H * T, H * T * M, H * T * M, H * T, H * T]
+    offs = np.cumsum([0] + sizes)
+    cons = big[offs[0]:offs[1]].reshape(H, T)
+    ins_base = big[offs[1]:offs[2]].reshape(H, T, M)
+    ins_votes = big[offs[2]:offs[3]].reshape(H, T, M)
+    ncov = big[offs[3]:offs[4]].reshape(H, T)
+    nwin = big[offs[4]:offs[5]].reshape(H, T)
+    bp = small[:H]
+    dlen = small[H:2 * H]
+    ovf = small[2 * H:3 * H] != 0
+    advance = small[3 * H:3 * H + R]
+    return cons, ins_base, ins_votes, ncov, nwin, bp, advance, dlen, ovf
+
+
 @functools.lru_cache(maxsize=8)
 def _pair_fill(params: AlignParams):
     """Jitted batched local fill with per-pair line hints — the device
@@ -662,8 +860,28 @@ class BatchExecutor:
         # mesh spans its own chips (ICI); a global mesh would make every
         # jit a cross-host SPMD program requiring identical inputs on all
         # processes.  Single-process: local == global, nothing changes.
-        n_dev = len(jax.local_devices())
-        if n_dev > 1:
+        self.slab_rows = pack_mod.pow2(max(1, cfg.slab_rows))
+        self._devices = jax.local_devices()
+        self._slab_rr = 0  # round-robin slab placement cursor
+        n_dev = len(self._devices)
+        # ragged pass-packing (pipeline/pack.py) replaces the per-P
+        # shape grouping for the production RefineRequest path, and
+        # scales across local chips by round-robining whole slabs (each
+        # an independent fused dispatch) instead of GSPMD-sharding one
+        # big dispatch.  An explicit --mesh selects the bucketed
+        # (Z, P)-sharded layout instead — packed slab rows cross hole
+        # boundaries, which the (data, pass) shardings cannot express.
+        # Output is byte-identical either way (tests/test_packing.py).
+        # A single-device host genuinely IGNORES --mesh (as it always
+        # has), so packing stays on there — "--mesh ignored" must not
+        # silently mean "and the bucketed grouping took over".
+        self._packing = bool(cfg.pass_packing) and (
+            cfg.mesh_shape is None or n_dev == 1)
+        if cfg.pass_packing and cfg.mesh_shape is not None and n_dev > 1:
+            print("[ccsx-tpu] pass packing disabled under --mesh "
+                  "(bucketed (Z, P) grouping carries the shardings)",
+                  file=sys.stderr)
+        if n_dev > 1 and not self._packing:
             # (data, pass) mesh: ZMWs shard over 'data'; MSA rows of each
             # hole shard over 'pass' when the pass bucket divides (GSPMD
             # partitions the jitted round from the input shardings alone —
@@ -766,6 +984,63 @@ class BatchExecutor:
         self.metrics.dp_round_cells_real += real
         self.metrics.dp_rowcells_real += rows_real * scale
         self.metrics.dp_rowcells_cap += len(idxs) * P * scale
+
+    def _count_cells_packed(self, reqs, idxs, qmax: int, R: int,
+                            iters: int):
+        """Padding accounting for one packed slab.  The slab IS the
+        dispatch (no Z axis), so rowcells_cap == round_cells_padded and
+        the factorized identity degenerates to z_fill = 1 with pass_fill
+        carrying the whole row-fill story; dp_rows_* feed the
+        dp_row_fill / packed_holes_per_dispatch counters the packing win
+        is read from (metrics.py)."""
+        if self.metrics is None:
+            return
+        band = self.cfg.align.band
+        scale = qmax * band * iters
+        rows_real = int(sum(int(reqs[i].row_mask.sum()) for i in idxs))
+        real = band * iters * int(
+            sum(int(reqs[i].qlens[reqs[i].row_mask].sum()) for i in idxs))
+        self.metrics.dp_cells_padded += R * scale
+        self.metrics.dp_cells_real += real
+        self.metrics.dp_round_cells_padded += R * scale
+        self.metrics.dp_round_cells_real += real
+        self.metrics.dp_rowcells_real += rows_real * scale
+        self.metrics.dp_rowcells_cap += R * scale
+        self.metrics.dp_rows_real += rows_real
+        self.metrics.dp_rows_dispatched += R
+        self.metrics.packed_dispatches += 1
+        self.metrics.packed_holes += len(idxs)
+
+    def _stack_slab(self, reqs, idxs, qmax, tmax):
+        """Pack the real pass-rows of the given requests into ONE slab:
+        (R, qmax) rows + (H, tmax) per-hole drafts + the row->hole
+        segment vector.  Row order is idxs order (the packing plan's
+        placement order — or a bisected half of it on the OOM-resplit
+        ladder, which re-packs at the smaller covering power of two)."""
+        rows = [int(reqs[i].row_mask.sum()) for i in idxs]
+        R, H = pack_mod.slab_shape(rows, self.slab_rows)
+        qs = np.zeros((R, qmax), np.uint8)
+        qlens = np.zeros((R,), np.int32)
+        row_mask = np.zeros((R,), bool)
+        seg = pack_mod.segment_ids(rows, R)
+        # empty hole slots: 1-col no-op drafts, like pad holes in
+        # _stack_group (pad rows gather a real slot's draft and are
+        # masked, so these are only ever the while_loop's frozen slots)
+        ts = np.full((H, tmax), banded.PAD, np.uint8)
+        ts[:, 0] = 0
+        tlens = np.ones((H,), np.int32)
+        r0 = 0
+        for s, i in enumerate(idxs):
+            req = reqs[i]
+            m = req.row_mask
+            n = rows[s]
+            qs[r0:r0 + n] = req.qs[m]
+            qlens[r0:r0 + n] = req.qlens[m]
+            row_mask[r0:r0 + n] = True
+            ts[s] = pad_to(req.draft, tmax)
+            tlens[s] = len(req.draft)
+            r0 += n
+        return qs, qlens, row_mask, seg, ts, tlens
 
     def _stack_group(self, reqs, idxs, P, qmax, tmax):
         """Pad + stack a shape group's requests into device inputs."""
@@ -872,6 +1147,8 @@ class BatchExecutor:
         draft outgrows the fused capacity (_fused_tmax) is replayed
         exactly on the host — the overflow flag makes the fallback
         bit-faithful, and the counter records how rare it is."""
+        if self._packing:
+            return self._run_refine_packed(requests)
         cfg = self.cfg
         groups: Dict[tuple, List[int]] = defaultdict(list)
         for i, req in enumerate(requests):
@@ -931,6 +1208,115 @@ class BatchExecutor:
         for (P, qmax, tmax, iters), idxs in groups.items():
             self._count_cells(requests, idxs, P, qmax,
                               self._round_z(len(idxs)), iters)
+        self._run_groups(groups, dispatch, finish, host_one, results)
+        return results
+
+    def _run_refine_packed(
+            self, requests: List[RefineRequest]) -> List[RefineResult]:
+        """Ragged pass-packed refinement: requests group only by
+        (qmax, tmax, iters) — the pass dimension is packed away — and
+        each group's (hole, pass) rows are laid into fixed (R, qmax)
+        slabs first-fit-decreasing by hole (pipeline/pack.py), one fused
+        dispatch per slab.  The recovery ladder is inherited unchanged:
+        a slab's idxs are its HOLES, so the OOM rung bisects by hole and
+        each half re-packs into a smaller covering slab, and the ladder
+        bottom replays per hole on refine_host, exactly as the bucketed
+        path does."""
+        cfg = self.cfg
+        nrows = [int(r.row_mask.sum()) for r in requests]
+        results: List[Optional[RefineResult]] = [None] * len(requests)
+        if self.metrics is not None:
+            self.metrics.windows += len(requests)
+
+        def host_one(i):
+            req = requests[i]
+            return refine_host(self._sm.round, req.qs, req.qlens,
+                               req.row_mask, req.draft, req.iters)
+
+        shape_groups: Dict[tuple, List[int]] = defaultdict(list)
+        for i, req in enumerate(requests):
+            if nrows[i] == 0:
+                # a request with no live pass-rows (degenerate; the
+                # windowed driver never produces one) has no rows to
+                # pack — the host path is its spec
+                if self.metrics is not None:
+                    self.metrics.host_fallbacks += 1
+                try:
+                    results[i] = host_one(i)
+                except Exception as e:  # quarantined per hole
+                    results[i] = e
+                continue
+            qmax = req.qs.shape[1]
+            tmax = _fused_tmax(len(req.draft), self.len_quant)
+            shape_groups[(qmax, tmax, req.iters)].append(i)
+
+        groups: Dict[tuple, List[int]] = {}
+        for key, idxs in shape_groups.items():
+            slabs = pack_mod.plan_slabs([nrows[i] for i in idxs],
+                                        self.slab_rows)
+            for s_no, slab in enumerate(slabs):
+                groups[key + (s_no,)] = [idxs[j] for j in slab]
+
+        if self.metrics is not None:
+            self.metrics.device_dispatches += len(groups)
+        for key, idxs in groups.items():
+            R, _ = pack_mod.slab_shape([nrows[i] for i in idxs],
+                                       self.slab_rows)
+            self._count_cells_packed(requests, idxs, key[0], R, key[2])
+
+        def dispatch(idxs, key):
+            qmax, tmax, iters, _ = key
+            args = self._stack_slab(requests, idxs, qmax, tmax)
+            faultinject.fire("device_oom")
+            step = _refine_step_packed(
+                cfg.align, cfg.max_ins_per_col, tmax, iters,
+                args[4].shape[0], self._bp_consts(),
+                pack=(args[0].shape[0], qmax))
+            big, small = _pack_slab_args(args)
+            if len(self._devices) > 1:
+                # slab-level data parallelism: each slab is an
+                # independent fused dispatch, so whole slabs round-robin
+                # across the local chips (committed inputs pin the jit
+                # execution) — no GSPMD partitioning, no cross-chip
+                # traffic, and the dispatch-all-then-finish sweep keeps
+                # every chip busy concurrently
+                dev = self._devices[self._slab_rr % len(self._devices)]
+                self._slab_rr += 1
+                big = jax.device_put(big, dev)
+                small = jax.device_put(small, dev)
+            return step(big, small)
+
+        def finish(idxs, key, out):
+            qmax, tmax, iters, _ = key
+            R, H = pack_mod.slab_shape([nrows[i] for i in idxs],
+                                       self.slab_rows)
+            (cons, ins_base, ins_votes, ncov, nwin, bp, advance, dlen,
+             ovf) = _unpack_slab_refine(
+                np.asarray(out[0]), np.asarray(out[1]),
+                cfg.max_ins_per_col, tmax, H, R)
+            r0 = 0
+            for s, i in enumerate(idxs):
+                req = requests[i]
+                n = nrows[i]
+                rows = slice(r0, r0 + n)
+                r0 += n
+                if ovf[s]:
+                    if self.metrics is not None:
+                        self.metrics.refine_overflows += 1
+                    results[i] = host_one(i)
+                    continue
+                # scatter row advances back into the request's (P,)
+                # pass order; masked pass rows consumed nothing — the
+                # same 0 the fixed-P device path computes for them
+                adv = np.zeros(req.qs.shape[0], np.int32)
+                adv[req.row_mask] = advance[rows]
+                rr = RoundResult(
+                    cons=cons[s], ins_base=ins_base[s],
+                    ins_votes=ins_votes[s], ncov=ncov[s], nwin=nwin[s],
+                    tlen=int(dlen[s]), bp=int(bp[s]), advance=adv,
+                )
+                results[i] = RefineResult(rr=rr)
+
         self._run_groups(groups, dispatch, finish, host_one, results)
         return results
 

@@ -59,6 +59,18 @@ class Metrics:
     dp_round_cells_padded: int = 0
     dp_rowcells_real: int = 0
     dp_rowcells_cap: int = 0
+    # ragged pass-packing (pipeline/pack.py): real (hole, pass) rows vs
+    # slab rows dispatched — dp_row_fill = rows_real / rows_dispatched
+    # is the packed analog of pass_fill x z_fill (a packed slab has no
+    # Z axis, so its z_fill is identically 1 and its pass_fill is the
+    # row fill; these plain row counts read the same story without the
+    # qmax/iters cell weighting) — and holes co-dispatched per slab
+    # (packed_holes_per_dispatch), the fragmentation counter that used
+    # to read ~1.7 windows/dispatch under bucketed grouping
+    dp_rows_real: int = 0
+    dp_rows_dispatched: int = 0
+    packed_dispatches: int = 0
+    packed_holes: int = 0
     # compressed input bytes this process ingested (byte-range sharded
     # BAM ingest reports its ~1/N share; full-parse paths report the
     # file size).  0 when unknown (stdin / pure-stream inputs).
@@ -134,6 +146,14 @@ class Metrics:
             "dp_z_fill": round(self.dp_rowcells_cap
                                / self.dp_round_cells_padded, 4)
                          if self.dp_round_cells_padded else None,
+            "dp_row_fill": round(self.dp_rows_real
+                                 / self.dp_rows_dispatched, 4)
+                           if self.dp_rows_dispatched else None,
+            "packed_holes_per_dispatch": round(self.packed_holes
+                                               / self.packed_dispatches,
+                                               2)
+                                         if self.packed_dispatches
+                                         else None,
             "ingest_bytes": self.ingest_bytes,
             "ingest_s": round(self.t_ingest, 6),
             "prep_s": round(self.t_prep, 6),

@@ -84,6 +84,7 @@ def test_make_index_rejects_fastx(tmp_path, capsys):
     assert "BAM" in capsys.readouterr().err
 
 
+@pytest.mark.slow  # ~80s: three sharded CLI runs + reference run
 def test_range_sharded_cli_merge_identical(tmp_path, rng):
     """End-to-end: --make-index, then 2 range-sharded host runs whose
     merge is byte-identical to the single-host batched run, with each

@@ -84,6 +84,7 @@ def test_empty_and_extreme_rows():
     _compare(qs, qlens, ts, tlens, AlignParams())
 
 
+@pytest.mark.slow  # ~43s: interpret-mode kernel at an extra batch shape
 def test_leading_batch_dims():
     """(Z, P, Qmax) nested batching reshapes correctly."""
     rng = np.random.default_rng(3)

@@ -130,6 +130,7 @@ def test_whole_read_too_few_passes(rng):
     assert whole_read.ccs_whole_read(zz, aligner, CFG) is None
 
 
+@pytest.mark.slow  # ~25s: consensus at four pass depths
 def test_quality_scales_with_passes(rng):
     """CCS signature: consensus accuracy must rise with pass count
     (>=Q20 by ~6 passes, >=Q25 by 10 at the default noise profile)."""

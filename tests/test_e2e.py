@@ -24,6 +24,7 @@ def _zmw_from_synth(z):
 
 # ---------- windowed consensus ----------
 
+@pytest.mark.slow  # ~37s: 6kb whole-molecule windowed run
 def test_windowed_matches_template_long_read(rng):
     """A >1-window molecule: the shred path must stitch windows correctly."""
     cfg = CcsConfig(is_bam=False, window_init=1024, window_add=1024,
@@ -38,6 +39,7 @@ def test_windowed_matches_template_long_read(rng):
     assert abs(len(cns) - 3000) < 60
 
 
+@pytest.mark.slow  # ~110s: 20kb molecule, ~10 windows
 def test_windowed_long_molecule_many_windows(rng):
     """4kb molecule, ~8 windows at the test window size: cursor re-sync
     must hold across many breakpoints with no drift (identity stays

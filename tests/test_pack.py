@@ -1,0 +1,129 @@
+"""Fast unit tier for the ragged pass-packer (pipeline/pack.py).
+
+The packer is pure host planning (no jax), so its invariants —
+first-fit-decreasing determinism, row-budget and capacity edge cases,
+segment-id round-trip — are pinned here in milliseconds; a packer
+regression fails in seconds, not via an e2e differential run."""
+
+import numpy as np
+import pytest
+
+from ccsx_tpu.pipeline import pack
+
+
+def test_pow2():
+    assert pack.pow2(0) == 1
+    assert pack.pow2(1) == 1
+    assert pack.pow2(3) == 4
+    assert pack.pow2(64) == 64
+    assert pack.pow2(65) == 128
+
+
+def test_slab_shape_full_slab_lands_on_budget():
+    R, H = pack.slab_shape([9, 11, 20, 24], 64)
+    assert (R, H) == (64, 16)
+
+
+def test_slab_shape_tail_shrinks_down_bounded_ladder():
+    """Partial slabs shrink to budget/8 multiples (pow2 below that) —
+    a small cached shape set, with worst-case tail waste < budget/8."""
+    assert pack.slab_shape([5, 6], 64) == (16, 4)   # 11 -> 2 quanta
+    assert pack.slab_shape([3], 64) == (4, 1)       # below quant: pow2
+    assert pack.slab_shape([10, 9, 9, 9], 64) == (40, 10)  # not pow2 48+
+    assert pack.slab_shape([30, 20], 128) == (64, 16)  # quant 16
+
+
+def test_slab_shape_capacity_floor():
+    """Many tiny holes: the SEG_DIV rows-per-slot floor keeps
+    H >= n_holes so every packed hole has a segment slot."""
+    rows = [1] * 10
+    R, H = pack.slab_shape(rows, 64)
+    assert H >= len(rows)
+    assert R == 40  # seg floor 4*10, rounded to the 8-row quant
+
+
+def test_slab_shape_oversize_hole_grows_R():
+    R, H = pack.slab_shape([100], 64)
+    assert R == 128 and H == 32
+
+
+def test_slab_shape_empty_raises():
+    with pytest.raises(ValueError):
+        pack.slab_shape([], 64)
+
+
+def test_plan_ffd_is_deterministic_and_decreasing():
+    rows = [9, 3, 17, 9, 5, 30, 12]
+    a = pack.plan_slabs(rows, 32)
+    b = pack.plan_slabs(rows, 32)
+    assert a == b
+    # placement order within a slab is descending rows, index-tiebroken
+    for slab in a:
+        rs = [rows[i] for i in slab]
+        assert rs == sorted(rs, reverse=True)
+    # equal-row ties break by original index
+    t = pack.plan_slabs([4, 4, 4], 16)
+    assert t == [[0, 1, 2]]
+
+
+def test_plan_covers_every_hole_once():
+    rows = [9, 3, 17, 9, 5, 30, 12, 1, 1, 28]
+    slabs = pack.plan_slabs(rows, 64)
+    got = sorted(i for s in slabs for i in s)
+    assert got == list(range(len(rows)))
+
+
+def test_plan_respects_row_budget():
+    rows = [20, 20, 20, 20, 20]
+    slabs = pack.plan_slabs(rows, 64)
+    for slab in slabs:
+        assert sum(rows[i] for i in slab) <= 64
+    assert len(slabs) == 2  # 3 + 2, not 5 singletons
+
+
+def test_plan_respects_segment_capacity():
+    """Holes smaller than SEG_DIV rows fill hole slots faster than rows;
+    the capacity (budget // SEG_DIV) must cap the slab."""
+    rows = [2] * 20
+    slabs = pack.plan_slabs(rows, 32)  # cap = 8 holes/slab
+    assert all(len(s) <= 8 for s in slabs)
+    assert len(slabs) == 3
+
+
+def test_plan_oversize_hole_gets_dedicated_slab():
+    rows = [70, 5, 5]
+    slabs = pack.plan_slabs(rows, 64)
+    assert [0] in slabs  # nothing can share the over-budget slab
+    assert sorted(map(sorted, slabs)) == [[0], [1, 2]]
+
+
+def test_plan_first_fit_backfills_earlier_slabs():
+    """A later small hole must land in the FIRST slab with room, not
+    open a new one."""
+    rows = [30, 28, 30, 4]
+    slabs = pack.plan_slabs(rows, 64)
+    # FFD order 30(i0), 30(i2), 28(i1), 4(i3): i1 overflows slab0
+    # (60+28), opens slab1; i3 then BACKFILLS slab0 to exactly 64
+    assert slabs == [[0, 2, 3], [1]]
+
+
+def test_segment_ids_round_trip():
+    rows = [3, 5, 2]
+    R, H = pack.slab_shape(rows, 32)
+    seg = pack.segment_ids(rows, R)
+    assert seg.dtype == np.int32 and len(seg) == R
+    # each hole's rows are contiguous and labeled with its slot
+    r0 = 0
+    for s, n in enumerate(rows):
+        assert (seg[r0:r0 + n] == s).all()
+        r0 += n
+    # padding tail: in range and sorted (the device segment-sums pass
+    # indices_are_sorted)
+    assert (seg[r0:] == len(rows) - 1).all()
+    assert (np.diff(seg) >= 0).all()
+    assert seg.max() < H
+
+
+def test_segment_ids_overflow_raises():
+    with pytest.raises(ValueError):
+        pack.segment_ids([10, 10], 16)

@@ -66,6 +66,7 @@ def test_max_passes_cap_regression(rng):
     assert ids[32] >= ids[40] - 0.005
 
 
+@pytest.mark.slow  # ~70s: two full windowed consensus runs per mode
 def test_window_growth_modes_identical_when_breakpoints_found(rng):
     """Measured invariant: the star-MSA's draft-anchored columns agree so
     the breakpoint scan succeeds and flush vs grow are bit-identical
@@ -82,6 +83,7 @@ def test_window_growth_modes_identical_when_breakpoints_found(rng):
     assert outs["flush"] == outs["grow"]
 
 
+@pytest.mark.slow  # ~130s: unbounded-growth parity mode recompiles at every grown window shape
 def test_window_growth_parity_mode_grows_past_cap(rng, monkeypatch):
     """Deterministic coverage of the growth machinery itself: with the
     breakpoint scan forced to fail N times, "grow" must escalate the

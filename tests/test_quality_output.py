@@ -57,6 +57,7 @@ def test_fastq_batched_equals_per_hole(tmp_path, rng, batch):
     assert o1.read_text() == o2.read_text()
 
 
+@pytest.mark.slow  # ~26s: long-molecule FASTQ run in both drivers
 def test_fastq_multiwindow_stitching_batched_parity(tmp_path, rng):
     """A >1-window molecule: per-window qual slices (materialize upto
     the breakpoint) must stitch to the same FASTQ in the per-hole and
