@@ -27,8 +27,102 @@ import time
 # benchmark shapes (kept canonical so compiles cache): Z zmws x P passes x W window
 Z, P, W, TLEN = 16, 8, 1024, 1000
 ITERS, WINDOWS = 25, 8
-BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             "bench_baseline.json")
+_HERE = os.path.dirname(os.path.abspath(__file__))
+BASELINE_PATH = os.path.join(_HERE, "bench_baseline.json")
+
+# >20% drop vs the previous bench artifact prints the loud warning and
+# sets the top-level "regressed" field
+REGRESSION_DROP = 0.8
+
+
+def _load_bench_line(path):
+    """Extract the bench JSON line from an artifact: the driver's
+    BENCH_r*.json wraps it under "parsed"; a raw `python bench.py`
+    capture IS the line.  None when unusable."""
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except (OSError, ValueError):
+        return None
+    line = d.get("parsed") if isinstance(d.get("parsed"), dict) else d
+    if not isinstance(line, dict) or "dp_cells_per_sec" not in line:
+        return None
+    return line
+
+
+def find_prev_bench(root=_HERE):
+    """The most recent prior bench artifact to gate against: the
+    highest-numbered usable BENCH_r*.json.  (bench_baseline.json is the
+    NATIVE-fill yardstick and already reported as vs_baseline — it is
+    not a prior bench line, so it never backs vs_prev.)  Returns
+    (artifact_name, line) or (None, None)."""
+    import glob
+    import re
+
+    cands = []
+    for p in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        if m:
+            cands.append((int(m.group(1)), p))
+    for _, p in sorted(cands, reverse=True):
+        line = _load_bench_line(p)
+        if line is not None:
+            return os.path.basename(p), line
+    return None, None
+
+
+def compare_with_prev(line, prev, artifact):
+    """Mutates ``line``: adds "vs_prev" (ratios vs the prior artifact
+    for dp_cells_per_sec and per-config e2e zmws_per_sec) and, on a
+    >20% drop in either, the top-level "regressed" field + a loud
+    stderr warning — the self-comparing trajectory VERDICT asked for.
+    Only same-backend artifacts are compared (an XLA:CPU run against a
+    TPU number is not a regression signal), and only e2e configs run
+    at the same hole count (zmws_per_sec is hole-count sensitive)."""
+    vp = {"artifact": artifact, "prev_backend": prev.get("backend")}
+    if prev.get("degraded"):
+        vp["prev_degraded"] = prev["degraded"]
+    regressed = []
+    if prev.get("backend") != line.get("backend"):
+        vp["skipped"] = (f"prev backend {prev.get('backend')!r} != "
+                         f"{line.get('backend')!r}; not comparable")
+    else:
+        if prev.get("dp_cells_per_sec") and line.get("dp_cells_per_sec"):
+            r = line["dp_cells_per_sec"] / prev["dp_cells_per_sec"]
+            vp["dp_cells_per_sec"] = round(r, 3)
+            if r < REGRESSION_DROP:
+                regressed.append(f"dp_cells_per_sec x{r:.2f}")
+        prev_e2e = {e.get("config"): e for e in prev.get("e2e", [])
+                    if isinstance(e, dict)}
+        ratios = {}
+        for e in line.get("e2e", []):
+            pe = prev_e2e.get(e.get("config"))
+            if (not pe or not pe.get("zmws_per_sec")
+                    or not e.get("zmws_per_sec")
+                    or pe.get("holes_in") != e.get("holes_in")
+                    # traced runs force per-dispatch execution; their
+                    # wall numbers are a different discipline than the
+                    # untraced async overlap — never cross-compare
+                    or bool(pe.get("traced")) != bool(e.get("traced"))):
+                continue
+            ratios[str(e["config"])] = round(
+                e["zmws_per_sec"] / pe["zmws_per_sec"], 3)
+        if ratios:
+            import math
+
+            g = math.exp(sum(math.log(r) for r in ratios.values())
+                         / len(ratios))
+            vp["zmws_per_sec"] = round(g, 3)
+            vp["zmws_per_sec_configs"] = ratios
+            if g < REGRESSION_DROP:
+                regressed.append(f"e2e zmws_per_sec x{g:.2f}")
+    line["vs_prev"] = vp
+    if regressed:
+        line["regressed"] = regressed
+        print("[bench] " + "!" * 20 + " REGRESSION vs " + str(artifact)
+              + ": " + "; ".join(regressed) + " (>20% drop) "
+              + "!" * 20, file=sys.stderr)
+    return vp
 
 
 def measure():
@@ -278,6 +372,12 @@ def _inner_main():
             os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
         import e2e as e2e_mod
 
+        # flight-recorder passthrough (utils/trace.py): CCSX_BENCH_TRACE
+        # is a path prefix — each config's span JSONL + Chrome export
+        # lands at <prefix>.c<N>.jsonl, and the per-shape-group
+        # compile/execute table rides each e2e entry below, so the
+        # bench artifact carries its own attribution evidence
+        trace_prefix = os.environ.get("CCSX_BENCH_TRACE")
         results = []
         for cfg in (1, 2, 3, 4, 5):
             if time.monotonic() > deadline:
@@ -285,14 +385,29 @@ def _inner_main():
                                 "skipped": "bench deadline exceeded"})
                 continue
             try:
-                r = e2e_mod.run_config(cfg, holes, "auto")
+                r = e2e_mod.run_config(
+                    cfg, holes, "auto",
+                    trace_path=(f"{trace_prefix}.c{cfg}.jsonl"
+                                if trace_prefix else None))
                 results.append({k: r.get(k) for k in (
                     "config", "backend", "holes_in", "holes_out",
                     "zmws_per_sec", "dp_row_fill",
-                    "packed_holes_per_dispatch", "mean_identity")})
+                    "packed_holes_per_dispatch", "groups", "degraded",
+                    "traced", "mean_identity")})
             except Exception as exc:  # keep the primary metric alive
                 results.append({"config": cfg, "error": repr(exc)[:200]})
         line["e2e"] = results
+
+    # bench regression gate: self-compare against the most recent prior
+    # BENCH_r*.json so the trajectory stops being write-only
+    prev_art, prev = find_prev_bench()
+    if prev is not None:
+        compare_with_prev(line, prev, prev_art)
+    else:
+        line["vs_prev"] = {"artifact": None,
+                           "note": "no prior BENCH_r*.json artifact; "
+                                   "vs_baseline reports the native "
+                                   "yardstick"}
 
     print(json.dumps(line))
 

@@ -78,15 +78,22 @@ def make_input(config: int, n_holes: int, rng, tmp):
     raise ValueError(config)
 
 
-def run_config(config: int, n_holes: int, batch: str, seed: int = 0) -> dict:
+def run_config(config: int, n_holes: int, batch: str, seed: int = 0,
+               trace_path: str = None,
+               stall_timeout: float = None) -> dict:
     rng = np.random.default_rng(seed)
     with tempfile.TemporaryDirectory() as tmp:
         in_path, args, zs = make_input(config, n_holes, rng, tmp)
         out = os.path.join(tmp, "out.fa")
         mpath = os.path.join(tmp, "m.jsonl")
+        extra = []
+        if trace_path:
+            extra += ["--trace", trace_path]
+        if stall_timeout is not None:
+            extra += ["--stall-timeout", str(stall_timeout)]
         t0 = time.perf_counter()
         rc = cli.main([*args, "--batch", batch, "--metrics", mpath,
-                       in_path, out])
+                       *extra, in_path, out])
         dt = time.perf_counter() - t0
         assert rc == 0, f"config {config}: rc={rc}"
         got = {r.name: r.seq for r in fastx.read_fastx(out)}
@@ -114,6 +121,15 @@ def run_config(config: int, n_holes: int, batch: str, seed: int = 0) -> dict:
             "dp_row_fill": final.get("dp_row_fill"),
             "packed_holes_per_dispatch": final.get(
                 "packed_holes_per_dispatch"),
+            # per-shape-group compile/execute attribution (utils/
+            # trace.py): lands in every bench artifact so throughput
+            # claims carry their own evidence
+            "groups": final.get("groups"),
+            "degraded": final.get("degraded"),
+            # tracing forces per-dispatch execution (Span.force), a
+            # different discipline than the async untraced overlap —
+            # recorded so vs_prev never compares across the two
+            "traced": bool(trace_path),
             "mean_identity": round(float(np.mean(idys)), 5) if idys else None,
         }
 
@@ -123,10 +139,19 @@ def main():
     ap.add_argument("--holes", type=int, default=16)
     ap.add_argument("--config", type=int, default=None, choices=range(1, 6))
     ap.add_argument("--batch", default="auto", choices=["auto", "on", "off"])
+    ap.add_argument("--trace", default=None,
+                    help="flight-recorder passthrough: per-config span "
+                         "JSONL at <PATH>.c<N>.jsonl")
+    ap.add_argument("--stall-timeout", type=float, default=None,
+                    dest="stall_timeout",
+                    help="hang-watchdog passthrough (seconds)")
     a = ap.parse_args()
     configs = [a.config] if a.config else [1, 2, 3, 4, 5]
     for c in configs:
-        print(json.dumps(run_config(c, a.holes, a.batch)), flush=True)
+        tp = f"{a.trace}.c{c}.jsonl" if a.trace else None
+        print(json.dumps(run_config(c, a.holes, a.batch, trace_path=tp,
+                                    stall_timeout=a.stall_timeout)),
+              flush=True)
 
 
 if __name__ == "__main__":

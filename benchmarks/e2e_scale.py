@@ -147,6 +147,11 @@ def run_scale(n_holes: int, inflight: int, rng, device: str = "auto",
             "stage_seconds": {k: final[k] for k in
                               ("ingest_s", "prep_s", "compute_s",
                                "write_s")},
+            # per-shape-group compile/execute attribution + watchdog
+            # verdict (utils/trace.py) — the artifact carries its own
+            # evidence that the numbers are chip time, not RPC pings
+            "groups": final.get("groups"),
+            "degraded": final.get("degraded"),
             "mean_identity": round(float(np.mean(idys)), 5) if idys else None,
         }
 
@@ -170,6 +175,14 @@ def main():
     ap.add_argument("--slab-rows", type=int, default=None,
                     help="forwarded to the CLI: pass-packing slab row "
                          "budget")
+    ap.add_argument("--trace", default=None,
+                    help="forwarded to the CLI: dispatch flight "
+                         "recorder span JSONL (+ Chrome export); the "
+                         "latency-floor run gets <PATH>.floor.jsonl")
+    ap.add_argument("--stall-timeout", type=float, default=None,
+                    dest="stall_timeout",
+                    help="forwarded to the CLI: hang-watchdog timeout "
+                         "seconds [CLI default 120]")
     ap.add_argument("--json", default=None)
     a = ap.parse_args()
     tlen_lo, tlen_hi = (int(x) for x in a.tlen.split(","))
@@ -188,8 +201,15 @@ def main():
     if a.slab_rows:
         extra = extra + ("--slab-rows", str(a.slab_rows))
         res["slab_rows"] = a.slab_rows
+    if a.stall_timeout is not None:
+        extra = extra + ("--stall-timeout", str(a.stall_timeout))
+        res["stall_timeout"] = a.stall_timeout
+    scale_extra = extra
+    if a.trace:
+        scale_extra = extra + ("--trace", a.trace)
+        res["trace"] = a.trace
     res["scale"] = run_scale(a.holes, a.inflight, rng, a.device,
-                             tlen_lo, tlen_hi, extra)
+                             tlen_lo, tlen_hi, scale_extra)
     if not a.skip_round:
         rm = res["round_metric"]["zmw_windows_per_sec"]
         ew = res["scale"]["zmw_windows_per_sec"]
@@ -200,9 +220,12 @@ def main():
         res["e2e_over_round"] = round(ew / rm, 3) if rm else None
     if a.floor_holes:
         rng2 = np.random.default_rng(7)
+        floor_extra = extra
+        if a.trace:
+            floor_extra = extra + ("--trace", a.trace + ".floor.jsonl")
         res["latency_floor"] = run_scale(a.floor_holes, a.inflight, rng2,
                                          a.device, tlen_lo, tlen_hi,
-                                         extra)
+                                         floor_extra)
     print(json.dumps(res, indent=1))
     if a.json:
         with open(a.json, "w") as f:

@@ -5,28 +5,45 @@
 # runtime neither blocks in block_until_ready nor executes unfetched
 # dispatches, so only fori_loop+checksum+fetch numbers are real.
 #
+# Every battery entry now runs with the dispatch flight recorder
+# (--trace / CCSX_BENCH_TRACE, utils/trace.py) and a LIVE stall
+# watchdog, so a mid-battery hang leaves thread stacks + the in-flight
+# shape group behind instead of another diagnostics-free dead tunnel
+# (the r5 failure mode).  Entries that bypass the CLI (round_profile,
+# pallas_ab) get a process-level `timeout` so a hang cannot block the
+# rest of the battery; summarize any trace afterwards with
+#   python -m ccsx_tpu.cli stats benchmarks/trace_r06_*.jsonl
+#
 #   sh benchmarks/tpu_battery.sh            # full battery
 set -x
 cd "$(dirname "$0")/.."
 
 # (1) the honest round number + compile-cache warm for the driver's
-# end-of-round bench (the fori_loop programs need one long compile)
-CCSX_BENCH_WATCHDOG=2400 python bench.py | tee benchmarks/bench_tpu_r05b.json
+# end-of-round bench; every e2e config records its span trace and the
+# per-shape-group compile/execute table rides the JSON artifact
+CCSX_BENCH_WATCHDOG=2400 CCSX_BENCH_TRACE=benchmarks/trace_r06_bench \
+    python bench.py | tee benchmarks/bench_tpu_r06.json
 
 # (2) e2e at scale over the packed transfer protocol (the CLI writes
-# real output files, so its wall-clock numbers are honest everywhere)
+# real output files, so its wall-clock numbers are honest everywhere);
+# --trace gives the Perfetto-loadable dispatch timeline and the default
+# 120 s stall watchdog is live through the CLI
 python benchmarks/e2e_scale.py --holes 256 --inflight 64 \
-    --json benchmarks/e2e_scale_r05_packed.json
+    --trace benchmarks/trace_r06_scale.jsonl \
+    --json benchmarks/e2e_scale_r06_packed.json
 
 # (3) honest per-stage round profile + op-level jax.profiler trace
 # (the artifact the roofline claim is checked against), then the
-# scan-projector A/B
-python benchmarks/round_profile.py --trace-dir benchmarks/trace_r05b \
-    --json benchmarks/round_profile_r05b.json
-CCSX_PROJECTOR=scan python benchmarks/round_profile.py \
-    --json benchmarks/round_profile_r05b_scanproj.json
+# scan-projector A/B.  These harnesses bypass the CLI, so the hang
+# guard is a hard process timeout (rc 124 = the step hung)
+timeout -k 30 2400 \
+    python benchmarks/round_profile.py --trace-dir benchmarks/trace_r06 \
+    --json benchmarks/round_profile_r06.json
+CCSX_PROJECTOR=scan timeout -k 30 2400 \
+    python benchmarks/round_profile.py \
+    --json benchmarks/round_profile_r06_scanproj.json
 
 # (4) pallas A/B with the honest harness if time remains
-python benchmarks/pallas_ab.py --mode check
-python benchmarks/pallas_ab.py --mode time --gblocks 8,16,32 \
-    --json benchmarks/pallas_ab_tpu_r05b.json
+timeout -k 30 1200 python benchmarks/pallas_ab.py --mode check
+timeout -k 30 2400 python benchmarks/pallas_ab.py --mode time \
+    --gblocks 8,16,32 --json benchmarks/pallas_ab_tpu_r06.json

@@ -41,6 +41,16 @@ TPU extensions (long options):
 --mesh D,P                --fastq                 --bam
 --refine-iters <int>      --max-passes <int>      --window-growth {flush,grow}
 --journal <path>          --metrics <path>        --profile <dir>
+--trace <path>            (dispatch flight recorder: span JSONL +
+                           Chrome/Perfetto trace export; device spans
+                           close only after block_until_ready, and the
+                           per-shape-group compile/execute table rides
+                           every --metrics event)
+--stall-timeout <sec>     (hang watchdog: a device dispatch open this
+                           long dumps all thread stacks + the in-flight
+                           shape group and marks the run degraded;
+                           first-of-shape dispatches get 10x the budget
+                           for cold compiles; 0 disables) [120]
 --hosts <int> --host-id <int> --coordinator <addr> --merge-shards <N>
 --merge-unmarked          (merge a legacy shard set without .done markers)
 --make-index              (index INPUT for byte-range sharded ingest)
@@ -48,6 +58,12 @@ TPU extensions (long options):
 --pass-buckets a,b,...    (bucketed-grouping A/B control: disables pass
                            packing and pads passes to these buckets)
 --inject-faults p@N,...   (deterministic fault injection; testing only)
+
+Subcommands:
+ccsx-tpu stats <jsonl>... (summarize --trace / --metrics artifacts:
+                           shape-group attribution table, stage
+                           breakdown, occupancy recap, slowest
+                           dispatches; any mix of files)
 """
 
 
@@ -126,6 +142,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Progress journal path for resumable runs")
     p.add_argument("--metrics", default=None,
                    help="Append JSON-lines metrics events to this path")
+    p.add_argument("--trace", default=None,
+                   help="Dispatch flight recorder: write span JSONL "
+                        "here (+ a Chrome trace-event export at close; "
+                        "utils/trace.py).  Device spans use the "
+                        "forced-execution close, and the per-group "
+                        "compile/execute table rides every metrics "
+                        "event")
+    p.add_argument("--stall-timeout", type=float, default=120.0,
+                   dest="stall_timeout", metavar="SEC",
+                   help="Hang watchdog: dump thread stacks + the "
+                        "in-flight shape group when a device dispatch "
+                        "stays open this long, and mark the run "
+                        "degraded (0 disables; the first dispatch of "
+                        "each shape gets 10x this budget — cold XLA "
+                        "compiles are not hangs) [120]")
     p.add_argument("--profile", default=None,
                    help="Write a jax.profiler trace to this directory")
     # multi-host (parallel/distributed.py): run one process per host with
@@ -151,7 +182,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--inject-faults", default=None, metavar="SPEC",
                    help="Deterministic fault injection for testing "
                         "recovery paths: point@N[+],... with points "
-                        "ingest, compute, device_oom, write, journal "
+                        "ingest, compute, device_oom, stall, write, "
+                        "journal "
                         "(utils/faultinject.py; CCSX_FAULTS env "
                         "equivalent)")
     return p
@@ -201,6 +233,11 @@ def config_from_args(args) -> CcsConfig:
         print(f"Error: --slab-rows must be >= 1, got {slab_rows}",
               file=sys.stderr)
         raise SystemExit(1)
+    stall_timeout = getattr(args, "stall_timeout", 120.0)
+    if stall_timeout < 0:
+        print(f"Error: --stall-timeout must be >= 0, got "
+              f"{stall_timeout}", file=sys.stderr)
+        raise SystemExit(1)
     return CcsConfig(
         min_subread_len=args.min_len,
         max_subread_len=args.max_len,
@@ -218,6 +255,8 @@ def config_from_args(args) -> CcsConfig:
         mesh_shape=mesh_shape,
         device=args.device,
         metrics_path=args.metrics,
+        trace_path=getattr(args, "trace", None),
+        stall_timeout_s=stall_timeout,
         # an explicit bucket list selects the bucketed-grouping control
         # path; the default is ragged pass packing (pipeline/pack.py)
         pass_packing=pass_buckets is None,
@@ -227,6 +266,14 @@ def config_from_args(args) -> CcsConfig:
 
 
 def main(argv: Optional[list] = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "stats":
+        # trace/metrics JSONL summarizer subcommand (no jax import, no
+        # backend init — safe on a host whose accelerator is hung)
+        from ccsx_tpu.utils import trace as trace_mod
+
+        return trace_mod.stats_main(argv[1:])
     args = build_parser().parse_args(argv)
     if args.help:
         return usage()  # rc 1, like the reference (main.c:761)

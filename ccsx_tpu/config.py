@@ -149,6 +149,13 @@ class CcsConfig:
 
     # ---- observability (SURVEY.md §5.1/5.5: absent in the reference) ----
     metrics_path: Optional[str] = None  # JSON-lines metrics events
+    trace_path: Optional[str] = None    # CLI --trace: dispatch flight
+    #   recorder (utils/trace.py) — span JSONL + Chrome trace export,
+    #   forced-execution device spans, per-shape-group compile/execute
+    #   attribution merged into every metrics event
+    stall_timeout_s: float = 120.0      # CLI --stall-timeout: the hang
+    #   watchdog fires when a device-dispatch span stays open this long,
+    #   dumping thread stacks + the in-flight shape group (0 disables)
 
     def metrics_stream(self):
         return open(self.metrics_path, "a") if self.metrics_path else None

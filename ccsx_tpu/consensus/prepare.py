@@ -227,10 +227,15 @@ def ccs_prepare_gen(codes: np.ndarray, lens, offs, cfg: CcsConfig):
 def drive_pairs(gen, aligner):
     """Run a PairRequest generator to completion with immediate
     (per-pair) strand_match dispatches; returns its result."""
+    from ccsx_tpu.utils import trace
+
     try:
         req = next(gen)
         while True:
-            req = gen.send(aligner.strand_match(req.q, req.t, req.pct))
+            with trace.span("pair_host", cat="prep",
+                            q=len(req.q), t=len(req.t)):
+                r = aligner.strand_match(req.q, req.t, req.pct)
+            req = gen.send(r)
     except StopIteration as e:
         return e.value
 

@@ -20,6 +20,7 @@ import numpy as np
 
 from ccsx_tpu.config import CcsConfig
 from ccsx_tpu.io.fastx import FastxRecord
+from ccsx_tpu.utils import trace
 
 
 class InvalidZmwName(ValueError):
@@ -100,3 +101,12 @@ def stream_zmws(records: Iterable[FastxRecord], cfg: CcsConfig) -> Iterator[Zmw]
     for z in group_zmws(records):
         if zmw_filter(z, cfg):
             yield z
+        else:
+            # filtered holes are otherwise invisible in a trace: the
+            # driver's ingest spans only see what this generator yields.
+            # Pure-Python ingest path ONLY — the native C++ streamer
+            # (native/io.py) applies the same filters in-library and
+            # emits no per-hole instants (a trace without zmw_filtered
+            # events does NOT mean nothing was filtered)
+            trace.instant("zmw_filtered", cat="ingest", hole=z.hole,
+                          passes=z.n_passes, bases=z.total_len)

@@ -208,6 +208,15 @@ def run_pipeline_sharded(in_path: str, out_path: str, cfg: CcsConfig,
 
     if not (0 <= rank < n):
         raise ValueError(f"rank {rank} outside [0, {n})")
+    if cfg.trace_path:
+        # per-rank flight-recorder files: ranks on one filesystem would
+        # otherwise clobber each other's span JSONL.  Metrics streams
+        # append and every event carries a wall-clock ts, so THOSE merge
+        # on a common timeline; the trace file is opened "w" per run.
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            cfg, trace_path=f"{cfg.trace_path}.shard{rank}")
     metrics = Metrics(verbose=cfg.verbose, stream=cfg.metrics_stream())
     # byte-range sharded ingest (SURVEY §5.8 "each host reads its own
     # input shard"): a fresh BGZF hole index (ccsx --make-index) lets

@@ -57,6 +57,7 @@ from ccsx_tpu.ops import encode as enc
 from ccsx_tpu.ops import traceback
 from ccsx_tpu.pipeline import pack as pack_mod
 from ccsx_tpu.utils import faultinject
+from ccsx_tpu.utils import trace
 from ccsx_tpu.utils.journal import Journal
 from ccsx_tpu.utils.metrics import Metrics
 
@@ -104,23 +105,61 @@ def classify_failure(exc: BaseException) -> str:
 
 # ---- failure recovery (shared by BatchExecutor and PairExecutor) ---------
 
+def _out_shape_tag(out):
+    """Shape signature of a dispatch's output pytree — the materialize
+    span's compile-grace key.  jit recompiles per distinct shape, and on
+    a fully lazy runtime the compile can block at MATERIALIZATION rather
+    than at dispatch, so the first wait on each (group, output-shape)
+    must get the watchdog's compile grace or a healthy cold recompile is
+    stamped degraded.  Output shapes change exactly when the compiled
+    signature does (the batch dim rides every output), so this is a
+    faithful per-executable key — and unlike the dispatch key it is
+    computable here, in the executor-generic wait path.  The key also
+    carries the output's device id(s): jit compiles one executable PER
+    DEVICE, and round-robined slabs materialize on different chips, so
+    each chip's first same-shape wait must get its own compile grace
+    (same rule as the dispatch span's :d{i} tag)."""
+    try:
+        leaves = jax.tree_util.tree_leaves(out)
+        tag = ",".join("x".join(str(d) for d in getattr(l, "shape", ()))
+                       for l in leaves)
+        for l in leaves:
+            devs = getattr(l, "devices", None)
+            if callable(devs):
+                tag += ":d" + "-".join(
+                    str(i) for i in sorted(d.id for d in devs()))
+                break
+        return tag
+    except Exception:
+        return None
+
+
 def _run_group_sync(idxs, key, dispatch, finish, host_one, results,
                     metrics, depth, max_resplits, backoff_s,
-                    compile_retried=False) -> None:
+                    compile_retried=False, label=str) -> None:
     """Dispatch+materialize one (sub)group synchronously, recovering
     from failures (used on the resplit/retry paths, where the happy
     path's dispatch-all-then-materialize overlap no longer applies)."""
     try:
-        finish(idxs, key, dispatch(idxs, key))
+        out = dispatch(idxs, key)
+        # same watchdog coverage as the happy path: on an async runtime
+        # a hang in a RETRIED dispatch would otherwise surface inside
+        # finish()'s materialization, invisible to the stall watchdog —
+        # exactly on the flaky-device runs most likely to be mid-recovery
+        with trace.device_span("materialize", group=label(key),
+                               shape=_out_shape_tag(out),
+                               attribute=False, n=len(idxs)):
+            out = jax.block_until_ready(out)
+        finish(idxs, key, out)
     except Exception as e:
         _recover_group(e, idxs, key, dispatch, finish, host_one, results,
                        metrics, depth, max_resplits, backoff_s,
-                       compile_retried)
+                       compile_retried, label=label)
 
 
 def _recover_group(exc, idxs, key, dispatch, finish, host_one, results,
                    metrics, depth, max_resplits, backoff_s,
-                   compile_retried=False) -> None:
+                   compile_retried=False, label=str) -> None:
     """The adaptive-retry ladder for one failed shape group.
 
     oom     -> bisect idxs (halves run at half the Z/N bucket), with
@@ -137,6 +176,8 @@ def _recover_group(exc, idxs, key, dispatch, finish, host_one, results,
                Exception the driver quarantines per hole)
     """
     kind = classify_failure(exc)
+    trace.instant("recover", cat="recover", kind=kind, group=label(key),
+                  n=len(idxs), depth=depth)
     if kind == "compile" and not compile_retried:
         from ccsx_tpu.consensus import star as star_mod
 
@@ -145,7 +186,8 @@ def _recover_group(exc, idxs, key, dispatch, finish, host_one, results,
             metrics.compile_fallbacks += 1
         return _run_group_sync(idxs, key, dispatch, finish, host_one,
                                results, metrics, depth, max_resplits,
-                               backoff_s, compile_retried=True)
+                               backoff_s, compile_retried=True,
+                               label=label)
     if kind == "oom" and depth < max_resplits and len(idxs) > 1:
         if metrics is not None:
             metrics.oom_resplits += 1
@@ -157,7 +199,7 @@ def _recover_group(exc, idxs, key, dispatch, finish, host_one, results,
         for part in (idxs[:mid], idxs[mid:]):
             _run_group_sync(part, key, dispatch, finish, host_one,
                             results, metrics, depth + 1, max_resplits,
-                            backoff_s, compile_retried)
+                            backoff_s, compile_retried, label=label)
         return
     print(f"[ccsx-tpu] device dispatch failed ({kind}) for a "
           f"{len(idxs)}-request group {key}; replaying on the host "
@@ -166,18 +208,25 @@ def _recover_group(exc, idxs, key, dispatch, finish, host_one, results,
         if metrics is not None:
             metrics.host_fallbacks += 1
         try:
-            results[i] = host_one(i)
+            with trace.span("host_replay", cat="recover",
+                            group=label(key), reason=kind):
+                results[i] = host_one(i)
         except Exception as he:  # quarantined per hole by the driver
             results[i] = he
 
 
 def _run_groups_recovering(groups, dispatch, finish, host_one, results,
                            metrics, max_resplits=3,
-                           backoff_s=0.05) -> None:
+                           backoff_s=0.05, label=str) -> None:
     """Happy path: dispatch every group's device work before
     materializing any result (jit dispatch is async, so group B's
     compute overlaps group A's d2h transfer); failures at either
-    phase drop that one group into the recovery ladder."""
+    phase drop that one group into the recovery ladder.  ``label``
+    maps a group key to the STABLE trace-group string the dispatch
+    spans use (e.g. dropping the packed path's per-slab ordinal), so
+    materialize spans share the dispatch namespace and the watchdog's
+    per-(group, shape) compile grace neither re-arms on every slab nor
+    misses a fresh shape's cold compile."""
     pending = []
     for key, idxs in groups.items():
         try:
@@ -188,10 +237,25 @@ def _run_groups_recovering(groups, dispatch, finish, host_one, results,
         try:
             if exc is not None:
                 raise exc
+            # watchdog coverage for the UNFORCED (untraced) case: on an
+            # async runtime the dispatch span closes in ~1 ms and a hung
+            # device surfaces HERE, when the outputs materialize — so
+            # the blocking wait alone is its own device span
+            # (attribute=False: it is wait, not chip work, and must not
+            # pollute the compile/execute group table; shape keys the
+            # compile grace — a lazy runtime may pay the cold compile in
+            # this wait, not at dispatch).  finish() stays OUTSIDE: its
+            # host work (overflow replays) is legitimately slow and must
+            # not trip the watchdog
+            with trace.device_span("materialize", group=label(key),
+                                   shape=_out_shape_tag(out),
+                                   attribute=False, n=len(idxs)):
+                out = jax.block_until_ready(out)
             finish(idxs, key, out)
         except Exception as e:
             _recover_group(e, idxs, key, dispatch, finish, host_one,
-                           results, metrics, 0, max_resplits, backoff_s)
+                           results, metrics, 0, max_resplits, backoff_s,
+                           label=label)
 
 
 @functools.lru_cache(maxsize=128)
@@ -790,7 +854,12 @@ class PairExecutor:
                 small[z, 2:6] = lines[i]
             faultinject.fire("device_oom")
             step = _pair_fill_packed(self.params, qmax, tmax)
-            return step(big, small)
+            with trace.device_span(
+                    "pair_fill", group=f"pair:q{qmax}:t{tmax}",
+                    cells=N * qmax * self.params.band,
+                    shape=f"N{N}", n=len(idxs)) as sp:
+                faultinject.fire("stall")
+                return sp.force(step(big, small))
 
         def finish(idxs, key, res):
             res = np.asarray(res)
@@ -816,7 +885,8 @@ class PairExecutor:
             return self._host_aligner.strand_match(pr.q, pr.t, pr.pct)
 
         _run_groups_recovering(groups, dispatch, finish, host_one,
-                               results, self.metrics)
+                               results, self.metrics,
+                               label=lambda k: f"pair:q{k[0]}:t{k[1]}")
         return results
 
 
@@ -1081,10 +1151,12 @@ class BatchExecutor:
                 results[i] = res
         return results
 
-    def _run_groups(self, groups, dispatch, finish, host_one, results):
+    def _run_groups(self, groups, dispatch, finish, host_one, results,
+                    label=str):
         _run_groups_recovering(groups, dispatch, finish, host_one,
                                results, self.metrics,
-                               self.max_oom_resplits, self.oom_backoff_s)
+                               self.max_oom_resplits, self.oom_backoff_s,
+                               label=label)
 
     def _run_rounds(self, requests: List[RoundRequest]) -> List[RoundResult]:
         cfg = self.cfg
@@ -1104,14 +1176,21 @@ class BatchExecutor:
             P, qmax, tmax = key
             args = self._stack_group(requests, idxs, P, qmax, tmax)
             faultinject.fire("device_oom")
-            if self._mesh is None:
-                # packed single-device transfers, as in _run_refine
+            Z = self._round_z(len(idxs))
+            with trace.device_span(
+                    "round", group=f"round:P{P}:q{qmax}:t{tmax}",
+                    cells=Z * P * qmax * cfg.align.band,
+                    shape=f"Z{Z}", n=len(idxs), Z=Z) as sp:
+                faultinject.fire("stall")
+                if self._mesh is None:
+                    # packed single-device transfers, as in _run_refine
+                    step = _round_step(cfg.align, cfg.max_ins_per_col,
+                                       tmax, self._bp_consts(),
+                                       pack=(P, qmax))
+                    return sp.force(step(*_pack_args(args)))
                 step = _round_step(cfg.align, cfg.max_ins_per_col, tmax,
-                                   self._bp_consts(), pack=(P, qmax))
-                return step(*_pack_args(args))
-            step = _round_step(cfg.align, cfg.max_ins_per_col, tmax,
-                               self._bp_consts())
-            return step(*self._shard_args(args, P))
+                                   self._bp_consts())
+                return sp.force(step(*self._shard_args(args, P)))
 
         def finish(idxs, key, out):
             P, qmax, tmax = key
@@ -1138,7 +1217,8 @@ class BatchExecutor:
         for (P, qmax, tmax), idxs in groups.items():
             self._count_cells(requests, idxs, P, qmax,
                               self._round_z(len(idxs)))
-        self._run_groups(groups, dispatch, finish, host_one, results)
+        self._run_groups(groups, dispatch, finish, host_one, results,
+                         label=lambda k: f"round:P{k[0]}:q{k[1]}:t{k[2]}")
         return results
 
     def _run_refine(self, requests: List[RefineRequest]) -> List[RefineResult]:
@@ -1165,16 +1245,23 @@ class BatchExecutor:
             P, qmax, tmax, iters = key
             args = self._stack_group(requests, idxs, P, qmax, tmax)
             faultinject.fire("device_oom")
-            if self._mesh is None:
-                # single device: packed transfer protocol (2 h2d + 2 d2h
-                # latencies per dispatch instead of 5 + 9)
+            Z = self._round_z(len(idxs))
+            with trace.device_span(
+                    "refine",
+                    group=f"refine:P{P}:q{qmax}:t{tmax}:i{iters}",
+                    cells=Z * P * qmax * cfg.align.band * iters,
+                    shape=f"Z{Z}", n=len(idxs), Z=Z) as sp:
+                faultinject.fire("stall")
+                if self._mesh is None:
+                    # single device: packed transfer protocol (2 h2d +
+                    # 2 d2h latencies per dispatch instead of 5 + 9)
+                    step = _refine_step(cfg.align, cfg.max_ins_per_col,
+                                        tmax, iters, self._bp_consts(),
+                                        pack=(P, qmax))
+                    return sp.force(step(*_pack_args(args)))
                 step = _refine_step(cfg.align, cfg.max_ins_per_col, tmax,
-                                    iters, self._bp_consts(),
-                                    pack=(P, qmax))
-                return step(*_pack_args(args))
-            step = _refine_step(cfg.align, cfg.max_ins_per_col, tmax,
-                                iters, self._bp_consts())
-            return step(*self._shard_args(args, P))
+                                    iters, self._bp_consts())
+                return sp.force(step(*self._shard_args(args, P)))
 
         def finish(idxs, key, out):
             P, qmax, tmax, iters = key
@@ -1191,7 +1278,9 @@ class BatchExecutor:
                 if ovf[z]:
                     if self.metrics is not None:
                         self.metrics.refine_overflows += 1
-                    results[i] = host_one(i)
+                    with trace.span("host_replay", cat="recover",
+                                    reason="refine_overflow"):
+                        results[i] = host_one(i)
                     continue
                 rr = RoundResult(
                     cons=cons[z], ins_base=ins_base[z],
@@ -1208,7 +1297,8 @@ class BatchExecutor:
         for (P, qmax, tmax, iters), idxs in groups.items():
             self._count_cells(requests, idxs, P, qmax,
                               self._round_z(len(idxs)), iters)
-        self._run_groups(groups, dispatch, finish, host_one, results)
+        self._run_groups(groups, dispatch, finish, host_one, results,
+                         label=lambda k: f"refine:P{k[0]}:q{k[1]}:t{k[2]}:i{k[3]}")
         return results
 
     def _run_refine_packed(
@@ -1242,7 +1332,9 @@ class BatchExecutor:
                 if self.metrics is not None:
                     self.metrics.host_fallbacks += 1
                 try:
-                    results[i] = host_one(i)
+                    with trace.span("host_replay", cat="recover",
+                                    reason="no_rows"):
+                        results[i] = host_one(i)
                 except Exception as e:  # quarantined per hole
                     results[i] = e
                 continue
@@ -1268,10 +1360,11 @@ class BatchExecutor:
             qmax, tmax, iters, _ = key
             args = self._stack_slab(requests, idxs, qmax, tmax)
             faultinject.fire("device_oom")
+            R = args[0].shape[0]
             step = _refine_step_packed(
                 cfg.align, cfg.max_ins_per_col, tmax, iters,
                 args[4].shape[0], self._bp_consts(),
-                pack=(args[0].shape[0], qmax))
+                pack=(R, qmax))
             big, small = _pack_slab_args(args)
             if len(self._devices) > 1:
                 # slab-level data parallelism: each slab is an
@@ -1284,7 +1377,21 @@ class BatchExecutor:
                 self._slab_rr += 1
                 big = jax.device_put(big, dev)
                 small = jax.device_put(small, dev)
-            return step(big, small)
+                # jit compiles one executable PER DEVICE: the first
+                # same-shape slab on each chip pays a compile, so the
+                # shape key carries the round-robin target
+                dtag = f":d{self._devices.index(dev)}"
+            else:
+                dtag = ""
+            with trace.device_span(
+                    "refine_packed",
+                    group=f"packed:q{qmax}:t{tmax}:i{iters}",
+                    cells=R * qmax * cfg.align.band * iters,
+                    shape=f"R{R}:S{args[4].shape[0]}{dtag}",
+                    plan={"slab": key[3], "rows": R,
+                          "holes": len(idxs)}) as sp:
+                faultinject.fire("stall")
+                return sp.force(step(big, small))
 
         def finish(idxs, key, out):
             qmax, tmax, iters, _ = key
@@ -1303,7 +1410,9 @@ class BatchExecutor:
                 if ovf[s]:
                     if self.metrics is not None:
                         self.metrics.refine_overflows += 1
-                    results[i] = host_one(i)
+                    with trace.span("host_replay", cat="recover",
+                                    reason="refine_overflow"):
+                        results[i] = host_one(i)
                     continue
                 # scatter row advances back into the request's (P,)
                 # pass order; masked pass rows consumed nothing — the
@@ -1317,7 +1426,8 @@ class BatchExecutor:
                 )
                 results[i] = RefineResult(rr=rr)
 
-        self._run_groups(groups, dispatch, finish, host_one, results)
+        self._run_groups(groups, dispatch, finish, host_one, results,
+                         label=lambda k: f"packed:q{k[0]}:t{k[1]}:i{k[2]}")
         return results
 
 
@@ -1420,7 +1530,8 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
             elif h.cns is not None and h.cns[0]:
                 name = f"{h.zmw.movie}/{h.zmw.hole}/ccs"
                 seq, qual = h.cns
-                with metrics.timer("write"):
+                with metrics.timer("write"), \
+                        trace.span("write_record", cat="write"):
                     if put_at is not None:
                         put_at(h.idx, name, seq, qual)
                     else:
@@ -1433,7 +1544,24 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
             metrics.tick()
             next_emit += 1
 
+    # the flight recorder (utils/trace.py): span JSONL under --trace,
+    # and the stall watchdog + group attribution regardless — the
+    # watchdog must be live on every batched run, or the next hang is
+    # another diagnostics-free dead tunnel.  Constructed INSIDE the try
+    # (finally tolerates tracer=None) so neither a watchdog thread nor
+    # an open trace file can leak, and an unwritable --trace path gets
+    # the same polite rc-1 refusal as an unwritable output path
+    tracer = None
     try:
+        try:
+            tracer = trace.Tracer(cfg.trace_path,
+                                  stall_timeout=cfg.stall_timeout_s,
+                                  metrics=metrics)
+        except OSError as e:
+            print(f"Cannot open trace file for write! ({e})",
+                  file=sys.stderr)
+            return 1
+        trace.install(tracer)
         while True:
             # admit up to the in-flight window; bound TOTAL outstanding
             # holes (incl. instantly-finished ones parked for ordered
@@ -1441,7 +1569,8 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
             while (not exhausted and len(active) < inflight
                    and next_idx - next_emit < 4 * inflight):
                 try:
-                    with metrics.timer("ingest"):
+                    with metrics.timer("ingest"), \
+                            trace.span("ingest_hole", cat="ingest"):
                         z = next(stream)
                         faultinject.fire("ingest")
                 except StopIteration:
@@ -1457,7 +1586,9 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
                     # timed as its own stage; the walk's pair alignments
                     # are batched below (benchmarks/prep_share.py is the
                     # criterion that forced this)
-                    with metrics.timer("prep"):
+                    with metrics.timer("prep"), \
+                            trace.span("prep_hole", cat="prep",
+                                       hole=str(z.hole)):
                         _start_hole(h, cfg)
                 if h.done:
                     finished[h.idx] = h
@@ -1476,12 +1607,16 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
             round_holes = [h for h in active
                            if not isinstance(h.req, prep_mod.PairRequest)]
             if pair_holes:
-                with metrics.timer("prep"):
+                with metrics.timer("prep"), \
+                        trace.span("pair_sweep", cat="prep",
+                                   n=len(pair_holes)):
                     pres = pair_executor.run([h.req for h in pair_holes])
                     for h, r in zip(pair_holes, pres):
                         _feed_hole(h, r)
             if round_holes:
-                with metrics.timer("compute"):
+                with metrics.timer("compute"), \
+                        trace.span("refine_sweep", cat="compute",
+                                   n=len(round_holes)):
                     rres = executor.run([h.req for h in round_holes])
                     for h, rr in zip(round_holes, rres):
                         _feed_hole(h, rr)
@@ -1508,6 +1643,11 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
         # settle the (possibly rate-limit-lagging) cursor AFTER the
         # writer has made the records durable
         journal.close()
+        # stop the watchdog + export the trace BEFORE the final metrics
+        # event, so a degraded mark set mid-run is in the "final"
+        trace.uninstall()
+        if tracer is not None:
+            tracer.close()
         metrics.report()
     return rc
 

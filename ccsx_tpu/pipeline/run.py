@@ -21,6 +21,7 @@ from ccsx_tpu.consensus.hole import ccs_hole
 from ccsx_tpu.io import bam as bam_mod
 from ccsx_tpu.io import fastx, zmw
 from ccsx_tpu.utils import faultinject
+from ccsx_tpu.utils import trace
 from ccsx_tpu.utils.device import resolve_device
 from ccsx_tpu.utils.journal import Journal
 from ccsx_tpu.utils.metrics import Metrics
@@ -134,7 +135,9 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
         stats: dict = {}
         try:
             faultinject.fire("compute")
-            return z, ccs_hole(z, aligner, cfg, stats), None, stats
+            with trace.span("hole_compute", cat="compute",
+                            hole=str(z.hole)):
+                return z, ccs_hole(z, aligner, cfg, stats), None, stats
         except Exception as e:  # quarantine: one bad hole must not kill the run
             return z, None, e, stats
 
@@ -149,7 +152,8 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
         metrics.windows += stats.get("windows", 0)
         metrics.device_dispatches += 3 * stats.get("windows", 0)
         wrote = False
-        with metrics.timer("write"):
+        with metrics.timer("write"), \
+                trace.span("write_record", cat="write"):
             if err is not None:
                 metrics.holes_failed += 1
                 print(f"[ccsx-tpu] hole {z.movie}/{z.hole} failed: {err}",
@@ -167,10 +171,29 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
     pool = ThreadPoolExecutor(max_workers=max(cfg.threads, 1)) \
         if cfg.threads > 1 else None
     pending = collections.deque()
+    # flight recorder: the per-hole path has no batched device-dispatch
+    # spans for the watchdog to watch (host compute dominates), but the
+    # span trace — ingest, per-hole compute (worker threads included),
+    # host pair alignments, writes, journal updates — records the same
+    # taxonomy the batched driver does.  Constructed INSIDE the try
+    # (finally tolerates tracer=None) so neither a watchdog thread nor
+    # an open trace file can leak, and an unwritable --trace path gets
+    # the same polite rc-1 refusal as an unwritable output path
+    tracer = None
     try:
+        try:
+            tracer = trace.Tracer(cfg.trace_path,
+                                  stall_timeout=cfg.stall_timeout_s,
+                                  metrics=metrics)
+        except OSError as e:
+            print(f"Cannot open trace file for write! ({e})",
+                  file=sys.stderr)
+            return 1
+        trace.install(tracer)
         while True:
             try:
-                with metrics.timer("ingest"):
+                with metrics.timer("ingest"), \
+                        trace.span("ingest_hole", cat="ingest"):
                     z = next(stream)
                     faultinject.fire("ingest")
             except StopIteration:
@@ -210,5 +233,8 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
         # settle the (possibly rate-limit-lagging) cursor AFTER the
         # writer has made the records durable
         journal.close()
+        trace.uninstall()
+        if tracer is not None:
+            tracer.close()
         metrics.report()
     return rc

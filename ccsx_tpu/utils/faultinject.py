@@ -26,6 +26,11 @@ Points and their actions (each placed at ONE spot in the pipeline):
   device_oom  raise RuntimeError("RESOURCE_EXHAUSTED...") at a
               BatchExecutor device dispatch — the OOM resplit/fallback
               ladder (pipeline/batch.py)
+  stall       sleep CCSX_FAULT_STALL_S seconds (default 1.0) INSIDE a
+              device dispatch, while its trace span is open — the
+              deterministic hang that proves the stall watchdog
+              (utils/trace.py, --stall-timeout) fires and dumps; the
+              dispatch then completes normally
   write       hard process exit (os._exit) after a record is written and
               flushed but BEFORE the journal advances — the torn-tail
               crash the journal v2 resume must repair
@@ -46,7 +51,7 @@ import os
 import threading
 from typing import Dict, Optional
 
-POINTS = ("ingest", "compute", "device_oom", "write", "journal")
+POINTS = ("ingest", "compute", "device_oom", "stall", "write", "journal")
 
 # exit code of the write/journal crash actions — distinctive, so a test
 # (or an operator) can tell an injected kill from a real failure
@@ -146,6 +151,17 @@ def fire(point: str) -> None:
         raise RuntimeError(
             "RESOURCE_EXHAUSTED: injected device OOM "
             f"(faultinject, call {n})")
+    if point == "stall":
+        # a hang, not a failure: sleep with the dispatch span open so
+        # the stall watchdog provably fires, then proceed normally
+        import time
+
+        try:
+            dur = float(os.environ.get("CCSX_FAULT_STALL_S", "1.0"))
+        except ValueError:
+            dur = 1.0
+        time.sleep(max(dur, 0.0))
+        return
     # write / journal: simulated SIGKILL — flush the injection notice,
     # then exit without running any cleanup
     sys.stderr.flush()

@@ -33,6 +33,7 @@ _SRC_DIRS = ("consensus", "ops", "pipeline")
 # bit-identical by the differential suite)
 _NON_SEMANTIC = frozenset({
     "threads", "verbose", "device", "mesh_shape", "metrics_path",
+    "trace_path", "stall_timeout_s",
     "pass_buckets", "zmw_microbatch", "chunk_size", "chunk_growth",
     "chunk_cap",
 })

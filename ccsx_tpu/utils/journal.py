@@ -46,6 +46,7 @@ import time
 from typing import Optional
 
 from ccsx_tpu.utils import faultinject
+from ccsx_tpu.utils import trace
 
 VERSION = 2
 
@@ -199,11 +200,12 @@ class Journal:
         if wrote and self.path:
             flush = getattr(writer, "flush", None)
             if flush is not None:
-                if metrics is not None:
-                    with metrics.timer("write"):
+                with trace.span("writer_flush", cat="write"):
+                    if metrics is not None:
+                        with metrics.timer("write"):
+                            flush()
+                    else:
                         flush()
-                else:
-                    flush()
             faultinject.fire("write")
         self.advance(out_bytes=getattr(writer, "bytes_out", None),
                      idx_bytes=getattr(writer, "idx_bytes_out", None))
@@ -233,6 +235,11 @@ class Journal:
     def _write(self) -> None:
         # the injected crash fires between the fsynced tmp and the
         # atomic replace: the OLD journal must survive intact
+        with trace.span("journal_update", cat="journal",
+                        holes_done=self.holes_done):
+            self._write_disk()
+
+    def _write_disk(self) -> None:
         write_json_atomic(
             self.path,
             {"version": VERSION,

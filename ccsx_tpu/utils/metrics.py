@@ -11,6 +11,7 @@ import contextlib
 import dataclasses
 import json
 import sys
+import threading
 import time
 from typing import Optional, TextIO
 
@@ -86,8 +87,25 @@ class Metrics:
     # a "progress" JSONL event is emitted every progress_every retired
     # holes (0 disables); "final" is always emitted at report()
     progress_every: int = 512
+    # per-shape-group dispatch attribution (utils/trace.py fills this:
+    # compiles, compile_s, execute_s, dispatches, dp_cells per group
+    # key) — rendered into every event by snapshot() so recompile
+    # storms and slow groups are visible in any metrics JSONL
+    group_stats: dict = dataclasses.field(default_factory=dict)
+    # set by the stall watchdog (utils/trace.py) when a device dispatch
+    # hangs past --stall-timeout: the run completed (or died) degraded,
+    # and every later event — including "final" — says so
+    degraded: Optional[str] = None
+    # set by the Tracer: True when device spans used the forced-
+    # execution close (--trace), i.e. the group table's seconds are
+    # real chip walls; False means dispatch-queue bookkeeping on an
+    # async backend (counts exact, seconds unreliable)
+    groups_forced: Optional[bool] = None
     _ticked: int = 0
     t0: float = dataclasses.field(default_factory=time.monotonic)
+    # emit() runs on the driver thread AND the stall-watchdog thread
+    _emit_lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock, repr=False)
 
     @contextlib.contextmanager
     def timer(self, stage: str):
@@ -117,8 +135,18 @@ class Metrics:
     def zmws_per_sec(self) -> float:
         return self.holes_out / self.elapsed
 
+    def _group_table(self) -> dict:
+        """Render group_stats for events, via the one shared finalizer
+        in utils/trace.py (summarize() uses the same one, so the table
+        from a metrics file and from a trace file cannot drift)."""
+        from ccsx_tpu.utils import trace
+
+        # dict() copy: the watchdog thread snapshots while the driver
+        # thread may be inserting a new group
+        return trace.finalize_group_table(dict(self.group_stats))
+
     def snapshot(self) -> dict:
-        return {
+        snap = {
             "holes_in": self.holes_in,
             "holes_out": self.holes_out,
             "holes_failed": self.holes_failed,
@@ -162,12 +190,24 @@ class Metrics:
             "elapsed_s": round(self.elapsed, 3),
             "zmws_per_sec": round(self.zmws_per_sec, 3),
         }
+        if self.group_stats:
+            snap["groups"] = self._group_table()
+            snap["groups_forced"] = bool(self.groups_forced)
+        if self.degraded:
+            snap["degraded"] = self.degraded
+        return snap
 
     def emit(self, event: str, **kw) -> None:
         if self.stream is not None:
-            rec = {"event": event, **self.snapshot(), **kw}
-            self.stream.write(json.dumps(rec) + "\n")
-            self.stream.flush()
+            # "ts" is the wall clock: elapsed_s alone cannot merge
+            # multi-host/sharded JSONL streams onto a common timeline
+            rec = {"event": event, "ts": round(time.time(), 6),
+                   **self.snapshot(), **kw}
+            with self._emit_lock:
+                if self.stream is None:  # closed under our feet
+                    return
+                self.stream.write(json.dumps(rec) + "\n")
+                self.stream.flush()
 
     def report(self) -> None:
         if self.verbose:
@@ -175,8 +215,9 @@ class Metrics:
         self.emit("final")
         if self.stream is not None and self.stream not in (sys.stdout,
                                                            sys.stderr):
-            try:
-                self.stream.close()
-            except OSError:
-                pass
-            self.stream = None
+            with self._emit_lock:
+                try:
+                    self.stream.close()
+                except OSError:
+                    pass
+                self.stream = None
