@@ -95,6 +95,34 @@ def compare_with_prev(line, prev, artifact):
         prev_e2e = {e.get("config"): e for e in prev.get("e2e", [])
                     if isinstance(e, dict)}
         ratios = {}
+        # per-group compile counts (the r7 storm gate): compiles are
+        # exact counts even untraced, so every same-config pair
+        # compares.  Flag a regression when any config's worst packed
+        # group now compiles more than the prior artifact's worst AND
+        # is past the canonical-ladder budget of 2 — growth within the
+        # ladder is legitimate tail variation, a return to 4-5 is the
+        # storm.
+        def _max_compiles(entry):
+            groups = entry.get("groups") or {}
+            packed = [st.get("compiles", 0) for k, st in groups.items()
+                      if str(k).startswith("packed:")]
+            return max(packed) if packed else None
+
+        compiles_cmp = {}
+        for e in line.get("e2e", []):
+            pe = prev_e2e.get(e.get("config"))
+            if not pe:
+                continue
+            cur_c, prev_c = _max_compiles(e), _max_compiles(pe)
+            if cur_c is not None and prev_c is not None:
+                compiles_cmp[str(e["config"])] = {"prev": prev_c,
+                                                  "cur": cur_c}
+                if cur_c > max(prev_c, 2):
+                    regressed.append(
+                        f"e2e c{e['config']} packed group compiles "
+                        f"{prev_c}->{cur_c} (compile storm)")
+        if compiles_cmp:
+            vp["group_compiles_max"] = compiles_cmp
         for e in line.get("e2e", []):
             pe = prev_e2e.get(e.get("config"))
             if (not pe or not pe.get("zmws_per_sec")
@@ -123,6 +151,36 @@ def compare_with_prev(line, prev, artifact):
               + ": " + "; ".join(regressed) + " (>20% drop) "
               + "!" * 20, file=sys.stderr)
     return vp
+
+
+def device_attempt_report(err: str, report_path=None):
+    """BENCH_r05 was a CPU fallback stamped 'tpu attempt hung' with NO
+    diagnostics — the whole artifact trail went dark.  Persist the
+    failed device attempt's stderr (which, since r7, carries the stall
+    watchdog's thread stacks + in-flight shape group) next to the
+    artifacts and embed the pointer + the last in-flight group in the
+    JSON line, so a degraded run is diagnosable from the artifact
+    alone."""
+    import re
+
+    report = {"stall_report": None, "last_inflight_group": None,
+              "stall_dumps": 0}
+    if not err:
+        return report
+    groups = re.findall(
+        r"STALL WATCHDOG: device dispatch '[^']*' group='([^']*)'", err)
+    report["stall_dumps"] = len(groups)
+    if groups:
+        report["last_inflight_group"] = groups[-1]
+    path = report_path or os.path.join(_HERE, "benchmarks",
+                                       "bench_stall_report.txt")
+    try:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(err[-200000:])
+        report["stall_report"] = os.path.relpath(path, _HERE)
+    except OSError as e:
+        report["stall_report_error"] = str(e)
+    return report
 
 
 def measure():
@@ -220,33 +278,41 @@ def main():
             r = subprocess.run([sys.executable, here], env=env,
                                timeout=timeout, capture_output=True,
                                text=True)
-        except subprocess.TimeoutExpired:
+            err = r.stderr or ""
+        except subprocess.TimeoutExpired as e:
+            # stderr captured so far still holds any stall-watchdog
+            # dump the hung attempt produced — that is the diagnosis
+            err = e.stderr or ""
+            if isinstance(err, bytes):
+                err = err.decode("utf-8", "replace")
             print("[bench] attempt timed out; backend hung mid-run",
                   file=sys.stderr)
-            return None
-        sys.stderr.write(r.stderr[-2000:])
+            return None, err
+        sys.stderr.write(err[-2000:])
         for line in reversed(r.stdout.splitlines()):
             if line.startswith("{"):
-                return line
-        return None
+                return line, err
+        return None, err
 
-    line = attempt({}, budget)
+    line, dev_err = attempt({}, budget)
     if line is None:
         print("[bench] retrying on CPU with reduced e2e", file=sys.stderr)
-        line = attempt({"JAX_PLATFORMS": "cpu",
-                        "CCSX_BENCH_E2E_HOLES": "4",
-                        # the budgeted retry must fit compile + measure
-                        # + e2e in watchdog/2: 3 windows x (1+10) CPU
-                        # rounds ~ 20 s of measurement
-                        "CCSX_BENCH_ITERS": "10",
-                        "CCSX_BENCH_WINDOWS": "3",
-                        "CCSX_BENCH_DEADLINE": "180"}, budget / 2)
+        line, _ = attempt({"JAX_PLATFORMS": "cpu",
+                           "CCSX_BENCH_E2E_HOLES": "4",
+                           # the budgeted retry must fit compile +
+                           # measure + e2e in watchdog/2: 3 windows x
+                           # (1+10) CPU rounds ~ 20 s of measurement
+                           "CCSX_BENCH_ITERS": "10",
+                           "CCSX_BENCH_WINDOWS": "3",
+                           "CCSX_BENCH_DEADLINE": "180"}, budget / 2)
         if line is not None:
             # mark the fallback so downstream consumers can't mistake
-            # XLA:CPU throughput for a TPU measurement/regression
+            # XLA:CPU throughput for a TPU measurement/regression —
+            # and attach the device attempt's post-mortem
             try:
                 d = json.loads(line)
                 d["degraded"] = "tpu attempt hung; CPU-fallback numbers"
+                d["device_attempt"] = device_attempt_report(dev_err)
                 line = json.dumps(d)
             except ValueError:
                 pass

@@ -144,6 +144,15 @@ def run_scale(n_holes: int, inflight: int, rng, device: str = "auto",
             "dp_row_fill": final.get("dp_row_fill"),
             "packed_holes_per_dispatch": final.get(
                 "packed_holes_per_dispatch"),
+            # compile-lean dispatch counters (r8): distinct packed slab
+            # shapes dispatched (the canonical ladder bounds this),
+            # compile seconds + share of wall, and the fused multi-chip
+            # wave fill
+            "distinct_slab_shapes": final.get("distinct_slab_shapes"),
+            "compile_s": final.get("compile_s"),
+            "compile_share": final.get("compile_share"),
+            "fused_waves": final.get("fused_waves"),
+            "fused_slot_fill": final.get("fused_slot_fill"),
             "stage_seconds": {k: final[k] for k in
                               ("ingest_s", "prep_s", "compute_s",
                                "write_s")},
@@ -175,6 +184,13 @@ def main():
     ap.add_argument("--slab-rows", type=int, default=None,
                     help="forwarded to the CLI: pass-packing slab row "
                          "budget")
+    ap.add_argument("--slab-shape-ladder", type=int, default=None,
+                    dest="slab_shape_ladder",
+                    help="forwarded to the CLI: canonical tail-slab "
+                         "heights per packed shape group [2]")
+    ap.add_argument("--no-warmup", action="store_true", dest="no_warmup",
+                    help="forwarded to the CLI: disable the AOT warmup "
+                         "precompiler (the warmup-on/off A/B arm)")
     ap.add_argument("--trace", default=None,
                     help="forwarded to the CLI: dispatch flight "
                          "recorder span JSONL (+ Chrome export); the "
@@ -201,6 +217,12 @@ def main():
     if a.slab_rows:
         extra = extra + ("--slab-rows", str(a.slab_rows))
         res["slab_rows"] = a.slab_rows
+    if a.slab_shape_ladder is not None:
+        extra = extra + ("--slab-shape-ladder", str(a.slab_shape_ladder))
+        res["slab_shape_ladder"] = a.slab_shape_ladder
+    if a.no_warmup:
+        extra = extra + ("--no-warmup",)
+        res["warmup"] = False
     if a.stall_timeout is not None:
         extra = extra + ("--stall-timeout", str(a.stall_timeout))
         res["stall_timeout"] = a.stall_timeout

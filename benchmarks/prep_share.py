@@ -125,9 +125,15 @@ def main():
         pairs.append(prep_mod.PairRequest(q, tpl, 75))
     host = HostAligner(AlignParams())
     pe = PairExecutor(AlignParams())
-    # warmup both paths (compiles)
+    # warm both arms before timing.  The device arm warms through the
+    # PRODUCTION warmup API (PairExecutor.warm — the same factory and
+    # zero-input dispatch the pipeline's AOT precompiler uses,
+    # pipeline/warmup.py) instead of the old hand-rolled double-run, so
+    # this bench's timings and the production path compile through one
+    # code path; without a WarmupCompiler attached, warm() is
+    # synchronous.
     host.strand_match(pairs[0].q, pairs[0].t, 75)
-    pe.run(pairs[:2])
+    pe.warm(pairs)
     t0 = time.perf_counter()
     for pr in pairs:
         host.strand_match(pr.q, pr.t, pr.pct)

@@ -32,6 +32,19 @@ python benchmarks/e2e_scale.py --holes 256 --inflight 64 \
     --trace benchmarks/trace_r06_scale.jsonl \
     --json benchmarks/e2e_scale_r06_packed.json
 
+# (2b) AOT-warmup A/B (r8): same scale config with the warmup
+# precompiler on (default) vs --no-warmup.  The warmup arm's trace
+# must show warmup spans booking the compiles and first dispatches
+# booking as execute; the wall-clock delta is the cold-compile time
+# the overlap hid.  Untraced so the async dispatch overlap is the
+# thing measured; the watchdog stays live regardless.
+python benchmarks/e2e_scale.py --holes 128 --inflight 64 \
+    --skip-round --floor-holes 0 \
+    --json benchmarks/e2e_scale_r08_warmup_on.json
+python benchmarks/e2e_scale.py --holes 128 --inflight 64 \
+    --skip-round --floor-holes 0 --no-warmup \
+    --json benchmarks/e2e_scale_r08_warmup_off.json
+
 # (3) honest per-stage round profile + op-level jax.profiler trace
 # (the artifact the roofline claim is checked against), then the
 # scan-projector A/B.  These harnesses bypass the CLI, so the hang

@@ -55,6 +55,13 @@ TPU extensions (long options):
 --merge-unmarked          (merge a legacy shard set without .done markers)
 --make-index              (index INPUT for byte-range sharded ingest)
 --slab-rows <int>         (ragged pass-packing row budget; default 128)
+--slab-shape-ladder <int> (canonical tail-slab heights per packed shape
+                           group: budget >> k for k < N — bounds each
+                           group to N XLA programs; 1 = all slabs
+                           full-height) [2]
+--no-warmup               (disable the AOT warmup precompiler: cold
+                           compiles then stall the first dispatch of
+                           each shape instead of overlapping ingest)
 --pass-buckets a,b,...    (bucketed-grouping A/B control: disables pass
                            packing and pads passes to these buckets)
 --inject-faults p@N,...   (deterministic fault injection; testing only)
@@ -119,6 +126,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pass-packing slab row budget (power of two; "
                         "rows from many holes share one (R, qmax) "
                         "dispatch) [128]")
+    p.add_argument("--slab-shape-ladder", type=int, default=None,
+                   metavar="N", dest="slab_shape_ladder",
+                   help="canonical tail-slab heights per packed shape "
+                        "group (budget >> k for k < N): bounds each "
+                        "group to N XLA programs in steady state; 1 = "
+                        "every slab dispatches at the full row budget "
+                        "[2]")
+    p.add_argument("--no-warmup", action="store_true", dest="no_warmup",
+                   help="disable the AOT warmup precompiler "
+                        "(pipeline/warmup.py): compiles then block the "
+                        "first dispatch of each shape instead of "
+                        "overlapping ingest/prep")
     p.add_argument("--fastq", action="store_true", dest="fastq",
                    help="Write FASTQ with per-base vote-margin qualities "
                         "instead of FASTA (extension; the reference "
@@ -233,6 +252,13 @@ def config_from_args(args) -> CcsConfig:
         print(f"Error: --slab-rows must be >= 1, got {slab_rows}",
               file=sys.stderr)
         raise SystemExit(1)
+    slab_ladder = getattr(args, "slab_shape_ladder", None)
+    if slab_ladder is not None and not 1 <= slab_ladder <= 8:
+        # > 8 heights would walk below budget/128 — that is the r7
+        # compile storm with extra steps, refuse it
+        print(f"Error: --slab-shape-ladder must be in [1, 8], got "
+              f"{slab_ladder}", file=sys.stderr)
+        raise SystemExit(1)
     stall_timeout = getattr(args, "stall_timeout", 120.0)
     if stall_timeout < 0:
         print(f"Error: --stall-timeout must be >= 0, got "
@@ -260,8 +286,11 @@ def config_from_args(args) -> CcsConfig:
         # an explicit bucket list selects the bucketed-grouping control
         # path; the default is ragged pass packing (pipeline/pack.py)
         pass_packing=pass_buckets is None,
+        warmup_compile=not getattr(args, "no_warmup", False),
         **({"pass_buckets": pass_buckets} if pass_buckets else {}),
         **({"slab_rows": slab_rows} if slab_rows else {}),
+        **({"slab_shape_ladder": slab_ladder}
+           if slab_ladder is not None else {}),
     )
 
 

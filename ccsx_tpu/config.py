@@ -136,8 +136,18 @@ class CcsConfig:
     max_passes: int = 32               # extra passes beyond this are dropped (deepest
     #   passes add negligible consensus signal; reference keeps all — documented delta)
     slab_rows: int = 128               # packed-slab row budget (power of two;
-    #   the Z-bucket analog for packed dispatches — tail slabs shrink down
-    #   the same pow2 ladder, so compile count stays logarithmic)
+    #   the Z-bucket analog for packed dispatches)
+    slab_shape_ladder: int = 2         # canonical tail-slab heights per
+    #   (qmax, tmax, iters) group: budget >> k for k < ladder (CLI
+    #   --slab-shape-ladder).  Bounds a packed group to <= ladder XLA
+    #   programs ever (the r7 flight recorder caught the finer budget/8
+    #   ladder paying 4-5 compiles per group); 1 = every slab dispatches
+    #   at the full budget
+    warmup_compile: bool = True        # AOT warmup precompiler (pipeline/
+    #   warmup.py): a background thread compiles each packed group's
+    #   canonical executables as soon as prep predicts them, overlapping
+    #   cold compiles with ingest instead of stalling the first dispatch
+    #   of every shape.  CLI --no-warmup disables
     zmw_microbatch: int = 64           # ZMWs per device dispatch
     len_bucket_quant: int = 512        # whole-read mode: lengths padded to multiple
 

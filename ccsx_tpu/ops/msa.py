@@ -81,10 +81,16 @@ def make_segment_voter(max_ins: int, num_segments: int):
     axis.  Empty hole slots get ncov == 0 -> cons GAP, like an all-pad
     block.  Integer scatter-adds are order-invariant, so the reduction
     order change cannot perturb results.
+
+    Deliberately UNJITTED (unlike make_voter, which tests and benches
+    call standalone): the sole consumer is the fused packed step
+    (pipeline/batch._round_body_packed), always inside an outer jit —
+    a nested jit there adds a dispatch-cache layer per trace and its
+    own executable cache entries per shape for zero benefit, against
+    the compile-lean dispatch discipline (r8).
     """
     H = num_segments
 
-    @jax.jit
     def vote(aligned, ins_cnt, ins_b, row_mask, seg):
         mask = row_mask[:, None]
 

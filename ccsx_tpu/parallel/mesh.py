@@ -36,6 +36,33 @@ from ccsx_tpu.config import AlignParams
 from ccsx_tpu.ops import banded, traceback
 
 
+def shard_map_compat(f, mesh: Mesh, in_specs, out_specs):
+    """jax.shard_map across the 0.4.x/0.6+ API split: the entry point
+    moved from jax.experimental.shard_map to jax.shard_map and the
+    replication check was renamed check_rep -> check_vma.  Both the
+    (data, pass) sharded round below and the fused multi-chip packed
+    dispatch (pipeline/batch.py) go through here, with the check
+    disabled for the same reason: DP scan carries mix replicated init
+    constants with varying values, and pcasting every carry component
+    buys nothing."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+
+
+def build_slab_mesh(devices) -> Mesh:
+    """A 1-D ('slab',) mesh over the given local devices — the fused
+    multi-chip packed dispatch stacks same-shape slabs into a leading
+    device dimension and shard_maps one executable over this mesh (one
+    transfer + one dispatch per group per wave, vs one of each per slab
+    per chip under the r7 round-robin)."""
+    return Mesh(np.array(devices), axis_names=("slab",))
+
+
 def build_mesh(shape: Optional[Tuple[int, ...]] = None,
                axis_names: Tuple[str, ...] = ("data", "pass"),
                devices=None) -> Mesh:
@@ -113,19 +140,7 @@ def make_sharded_round(mesh: Mesh, params: AlignParams, tmax: int,
     out_specs = (P("data", None), P("data", None, None),
                  P("data", None, None), P("data", None),
                  P("data", None))
-    # the DP scan carry mixes replicated init constants with varying
-    # values; skip the varying-manual-axes consistency check rather than
-    # pcast every carry component.  jax.shard_map (with check_vma) only
-    # exists from jax 0.6; on the 0.4.x line the same entry point is
-    # jax.experimental.shard_map with the check named check_rep.
-    if hasattr(jax, "shard_map"):
-        shard = jax.shard_map(local_round, mesh=mesh, in_specs=in_specs,
-                              out_specs=out_specs, check_vma=False)
-    else:
-        from jax.experimental.shard_map import shard_map as _shard_map
-
-        shard = _shard_map(local_round, mesh=mesh, in_specs=in_specs,
-                           out_specs=out_specs, check_rep=False)
+    shard = shard_map_compat(local_round, mesh, in_specs, out_specs)
     return jax.jit(shard)
 
 

@@ -598,9 +598,8 @@ def _round_body_packed(params: AlignParams, max_ins: int, tmax: int,
 
 
 @functools.lru_cache(maxsize=128)
-def _refine_step_packed(params: AlignParams, max_ins: int, tmax: int,
-                        iters: int, nseg: int, bp_consts: tuple,
-                        pack: tuple | None = None):
+def _refine_core_packed(params: AlignParams, max_ins: int, tmax: int,
+                        iters: int, nseg: int, bp_consts: tuple):
     """The fused whole-window refinement loop over ONE packed slab —
     _refine_step's ragged twin.  The while_loop carries per-SEGMENT
     (hole-slot) fixpoint state instead of per-Z-slot state: hole-shaped
@@ -610,11 +609,11 @@ def _refine_step_packed(params: AlignParams, max_ins: int, tmax: int,
     the segment vector.  Same fixpoint/overflow semantics as the
     bucketed step (which tests pin against refine_host, the spec).
 
-    pack=(R, qmax) selects the transfer-packed single-device variant:
-    the 6 slab inputs ride ONE 1-D uint8 + ONE 1-D int32 buffer and the
-    9 outputs one of each (see _pack_slab_args; rationale in
-    _round_step).  The packed path runs only without a device mesh, so
-    unlike _refine_step there is no sharded multi-array variant."""
+    This is the UNJITTED core; _refine_step_packed wraps it in the
+    single-device slab wire protocol and _refine_step_packed_fused in
+    the multi-chip (D, slab) shard_map — both compile the same
+    computation, which is what keeps single-chip and multi-chip output
+    byte-identical."""
     import jax.numpy as jnp
 
     from ccsx_tpu.ops import breakpoint as bp_mod
@@ -691,11 +690,45 @@ def _refine_step_packed(params: AlignParams, max_ins: int, tmax: int,
                 ncov.astype(jnp.uint8), nwin.astype(jnp.uint8),
                 bp, advance, dlen, ovf)
 
-    if pack is None:
-        return jax.jit(core)
-    R, qmax = pack
+    return core
 
-    @jax.jit
+
+def _slab_wire_sizes(R: int, qmax: int, H: int, tmax: int,
+                     max_ins: int) -> tuple:
+    """(Lbig, Lsmall) — the COMMON padded lengths of the slab wire
+    protocol's uint8 and int32 buffers, covering both the input and the
+    output payload.  Padding the smaller side to the larger one costs a
+    few KB of zeros on latency-dominated transfers (measured r5: the
+    fixed ~30-100 ms per-transfer latency dwarfs bandwidth at slab
+    sizes) and buys REAL buffer donation: with in/out avals identical,
+    XLA aliases each output onto its donated input buffer, so the
+    fixpoint loop's dispatch allocates no fresh output HBM and the r7
+    per-dispatch alloc/free churn on the packed path disappears.
+    (Donation with mismatched sizes is silently dropped by XLA — a
+    warning, not an alias — so the padding is what makes
+    donate_argnums mean anything.)"""
+    big_in = R * qmax + H * tmax
+    big_out = H * tmax * (3 + 2 * max_ins)
+    small_in = 3 * R + H
+    small_out = 3 * H + R
+    return max(big_in, big_out), max(small_in, small_out)
+
+
+def _packed_wire_step(params: AlignParams, max_ins: int, tmax: int,
+                      iters: int, nseg: int, bp_consts: tuple,
+                      R: int, qmax: int):
+    """Unjitted slab wire step: ONE 1-D uint8 + ONE 1-D int32 buffer in
+    (see _pack_slab_args; rationale in _round_step), one of each out,
+    both at the common _slab_wire_sizes lengths so donation aliases.
+    _refine_step_packed jits it per slab shape; the fused multi-chip
+    variant vmaps it over a leading device dimension."""
+    import jax.numpy as jnp
+
+    core = _refine_core_packed(params, max_ins, tmax, iters, nseg,
+                               bp_consts)
+    H = nseg
+    Lbig, Lsmall = _slab_wire_sizes(R, qmax, H, tmax, max_ins)
+
     def step(big, small):
         args = _unpack_slab_args_jax(big, small, R, qmax, H, tmax)
         (cons, ins_base, ins_votes, ncov, nwin, bp, advance, dlen,
@@ -705,30 +738,91 @@ def _refine_step_packed(params: AlignParams, max_ins: int, tmax: int,
             ins_votes.reshape(-1), ncov.reshape(-1), nwin.reshape(-1)])
         small_out = jnp.concatenate(
             [bp, dlen, ovf.astype(jnp.int32), advance]).astype(jnp.int32)
+        big_out = jnp.pad(big_out, (0, Lbig - big_out.shape[0]))
+        small_out = jnp.pad(small_out, (0, Lsmall - small_out.shape[0]))
         return big_out, small_out
 
     return step
 
 
-def _pack_slab_args(args):
+@functools.lru_cache(maxsize=128)
+def _refine_step_packed(params: AlignParams, max_ins: int, tmax: int,
+                        iters: int, nseg: int, bp_consts: tuple,
+                        pack: tuple):
+    """Jitted single-device packed refine step at pack=(R, qmax), with
+    both wire buffers DONATED: the input slab is dead the moment the
+    step owns it, and at the common wire sizes XLA aliases the outputs
+    onto it in place (_slab_wire_sizes) — no fresh output allocation
+    per dispatch."""
+    R, qmax = pack
+    step = _packed_wire_step(params, max_ins, tmax, iters, nseg,
+                             bp_consts, R, qmax)
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+@functools.lru_cache(maxsize=64)
+def _refine_step_packed_fused(params: AlignParams, max_ins: int,
+                              tmax: int, iters: int, nseg: int,
+                              bp_consts: tuple, pack: tuple, mesh):
+    """ONE fused multi-chip packed dispatch: same-shape slabs stacked
+    into a leading device dimension (Dstack, Lbig)/(Dstack, Lsmall) and
+    shard_mapped over the 1-D local ('slab',) mesh — one transfer and
+    ONE executable call per group per wave, where the r7 round-robin
+    issued one device_put + one dispatch per slab per chip and jit
+    compiled one executable PER chip (the :d{i} shape tags the flight
+    recorder surfaced).  Each chip runs the identical per-slab wire
+    step on its own slab with no cross-chip traffic; a dummy (all-zero)
+    slab freezes every segment at iteration 0, so padding a tail wave
+    up to D costs that chip ~a breakpoint scan on zeros.  Dstack is
+    normally D; an OOM-resplit re-plan can exceed D slabs, in which
+    case the local leading dim K = Dstack/D > 1 and the vmap carries K
+    slabs per chip — still one executable call.  Wire buffers donated,
+    as in the single-device step."""
+    from jax.sharding import PartitionSpec as PS
+
+    from ccsx_tpu.parallel.mesh import shard_map_compat
+
+    R, qmax = pack
+    step = _packed_wire_step(params, max_ins, tmax, iters, nseg,
+                             bp_consts, R, qmax)
+    sh = shard_map_compat(
+        lambda bigs, smalls: jax.vmap(step)(bigs, smalls), mesh,
+        in_specs=(PS("slab", None), PS("slab", None)),
+        out_specs=(PS("slab", None), PS("slab", None)))
+    return jax.jit(sh, donate_argnums=(0, 1))
+
+
+def _pack_slab_args(args, max_ins: int):
     """Host side of the slab transfer protocol: the 6 packed-refine
     inputs become one 1-D uint8 and one 1-D int32 buffer (one h2d
-    latency each — same fixed-latency rationale as _pack_args)."""
+    latency each — same fixed-latency rationale as _pack_args), zero-
+    padded to the common _slab_wire_sizes lengths so the device step
+    can write its outputs in place over the donated inputs."""
     qs, qlens, row_mask, seg, ts, tlens = args
-    big = np.concatenate([qs.reshape(-1), ts.reshape(-1)])
-    small = np.concatenate([qlens, row_mask.astype(np.int32), seg, tlens])
+    R, qmax = qs.shape
+    H, tmax = ts.shape
+    Lbig, Lsmall = _slab_wire_sizes(R, qmax, H, tmax, max_ins)
+    big = np.zeros(Lbig, np.uint8)
+    big[:R * qmax] = qs.reshape(-1)
+    big[R * qmax:R * qmax + H * tmax] = ts.reshape(-1)
+    small = np.zeros(Lsmall, np.int32)
+    small[:R] = qlens
+    small[R:2 * R] = row_mask
+    small[2 * R:3 * R] = seg
+    small[3 * R:3 * R + H] = tlens
     return big, small
 
 
 def _unpack_slab_args_jax(big, small, R: int, qmax: int, H: int,
                           tmax: int):
-    """Device side of _pack_slab_args."""
+    """Device side of _pack_slab_args (explicit slice ends: the wire
+    buffers carry alignment padding past the payload)."""
     qs = big[:R * qmax].reshape(R, qmax)
-    ts = big[R * qmax:].reshape(H, tmax)
+    ts = big[R * qmax:R * qmax + H * tmax].reshape(H, tmax)
     qlens = small[:R]
     row_mask = small[R:2 * R] != 0
     seg = small[2 * R:3 * R]
-    tlens = small[3 * R:]
+    tlens = small[3 * R:3 * R + H]
     return qs, qlens, row_mask, seg, ts, tlens
 
 
@@ -801,11 +895,45 @@ class PairExecutor:
     """
 
     def __init__(self, params: AlignParams, quant: int = 512,
-                 metrics=None):
+                 metrics=None, warmup=None):
         self.params = params
         self.quant = quant
         self.metrics = metrics
+        self._warmup = warmup      # AOT precompiler (pipeline/warmup.py)
+        self._warmed: set = set()  # inline-warm dedupe (no compiler)
         self._host_aligner = None  # built lazily, on first fallback
+
+    def warm(self, pairs) -> None:
+        """Precompile the padded pair-fill executables this pair list
+        will need, through the SAME factory + dispatch path run() uses
+        (benchmarks/prep_share.py warms through this instead of its old
+        hand-rolled double-run, so its timings and production compile
+        through one code path).  Asynchronous with a WarmupCompiler
+        (drain() to sync), inline without one.  The predicted N is an
+        upper bound — a pair that fails seeding drops out of its bucket
+        and can shrink N to a smaller (also canonical pow2) batch,
+        which run() then compiles as usual."""
+        buckets: Dict[tuple, int] = defaultdict(int)
+        for pr in pairs:
+            buckets[(bucket_len(len(pr.q), self.quant),
+                     bucket_len(len(pr.t), self.quant))] += 1
+        for (qmax, tmax), n in buckets.items():
+            N = _z_bucket(n)
+            key = ("pair_fill", qmax, tmax, N)
+            build = functools.partial(self._warm_build, qmax, tmax, N)
+            if self._warmup is not None:
+                self._warmup.submit(key, build)
+            elif key not in self._warmed:
+                self._warmed.add(key)
+                build()
+
+    def _warm_build(self, qmax, tmax, N) -> None:
+        step = _pair_fill_packed(self.params, qmax, tmax)
+        big = np.full((N, qmax + tmax), banded.PAD, np.uint8)
+        small = np.zeros((N, 6), np.int32)
+        with trace.device_span("warmup", group=f"pair:q{qmax}:t{tmax}",
+                               shape=f"N{N}", warmup=True):
+            jax.block_until_ready(step(big, small))
 
     def run(self, pairs: List["prep_mod.PairRequest"]):
         """Satisfy all pair requests; results align index-for-index as
@@ -853,6 +981,12 @@ class PairExecutor:
                 small[z, 1] = len(pairs[i].t)
                 small[z, 2:6] = lines[i]
             faultinject.fire("device_oom")
+            if self._warmup is not None:
+                # cancel a queued warmup of this shape / wait out an
+                # in-flight one (same discipline as the refine path)
+                ev = self._warmup.claim(("pair_fill", qmax, tmax, N))
+                if ev is not None:
+                    ev.wait()
             step = _pair_fill_packed(self.params, qmax, tmax)
             with trace.device_span(
                     "pair_fill", group=f"pair:q{qmax}:t{tmax}",
@@ -916,10 +1050,14 @@ class BatchExecutor:
     max_oom_resplits = 3
     oom_backoff_s = 0.05
 
-    def __init__(self, cfg: CcsConfig, metrics=None):
+    def __init__(self, cfg: CcsConfig, metrics=None, warmup=None,
+                 devices=None):
         self.cfg = cfg
         self.len_quant = cfg.len_bucket_quant
         self.metrics = metrics
+        # AOT warmup precompiler (pipeline/warmup.py), shared with the
+        # driver's PairExecutor; None = --no-warmup / legacy callers
+        self._warmup = warmup
         # host-replay spec for fused-refine overflows (rare): the exact
         # per-hole loop the fused step mirrors
         self._sm = StarMsa(cfg.align, cfg.max_ins_per_col,
@@ -930,15 +1068,27 @@ class BatchExecutor:
         # mesh spans its own chips (ICI); a global mesh would make every
         # jit a cross-host SPMD program requiring identical inputs on all
         # processes.  Single-process: local == global, nothing changes.
+        # ``devices`` narrows the set (tests pin the single-chip vs
+        # multi-chip byte identity with it).
         self.slab_rows = pack_mod.pow2(max(1, cfg.slab_rows))
-        self._devices = jax.local_devices()
-        self._slab_rr = 0  # round-robin slab placement cursor
+        self.slab_ladder = max(1, int(getattr(cfg, "slab_shape_ladder",
+                                              pack_mod.DEFAULT_LADDER)))
+        self._devices = (list(devices) if devices is not None
+                         else jax.local_devices())
+        self._shape_seen: set = set()  # distinct packed (R,q,t,i) shapes
+        # warm_refine's per-group row accumulator: group -> (rows_seen
+        # capped at budget, predicted canonical R, submitted warm key),
+        # with each hole counted once per group (_group_holes)
+        self._group_pred: Dict[tuple, tuple] = {}
+        self._group_holes: Dict[tuple, set] = {}
         n_dev = len(self._devices)
         # ragged pass-packing (pipeline/pack.py) replaces the per-P
         # shape grouping for the production RefineRequest path, and
-        # scales across local chips by round-robining whole slabs (each
-        # an independent fused dispatch) instead of GSPMD-sharding one
-        # big dispatch.  An explicit --mesh selects the bucketed
+        # scales across local chips with ONE fused multi-chip dispatch
+        # per group per wave (same-shape slabs stacked on a leading
+        # device dim under a ('slab',) shard_map — see
+        # _refine_step_packed_fused) instead of GSPMD-sharding one big
+        # dispatch.  An explicit --mesh selects the bucketed
         # (Z, P)-sharded layout instead — packed slab rows cross hole
         # boundaries, which the (data, pass) shardings cannot express.
         # Output is byte-identical either way (tests/test_packing.py).
@@ -947,6 +1097,11 @@ class BatchExecutor:
         # silently mean "and the bucketed grouping took over".
         self._packing = bool(cfg.pass_packing) and (
             cfg.mesh_shape is None or n_dev == 1)
+        self._slab_mesh = None
+        if self._packing and n_dev > 1:
+            from ccsx_tpu.parallel.mesh import build_slab_mesh
+
+            self._slab_mesh = build_slab_mesh(self._devices)
         if cfg.pass_packing and cfg.mesh_shape is not None and n_dev > 1:
             print("[ccsx-tpu] pass packing disabled under --mesh "
                   "(bucketed (Z, P) grouping carries the shardings)",
@@ -964,7 +1119,7 @@ class BatchExecutor:
             from ccsx_tpu.parallel.mesh import build_mesh
 
             self._mesh = build_mesh(shape=shape,
-                                    devices=jax.local_devices()[:ndev_used])
+                                    devices=self._devices[:ndev_used])
             self._data_dim, self._pass_dim = shape
             if (self._pass_dim > 1
                     and all(b % self._pass_dim for b in cfg.pass_buckets)):
@@ -1081,14 +1236,209 @@ class BatchExecutor:
         self.metrics.packed_dispatches += 1
         self.metrics.packed_holes += len(idxs)
 
-    def _stack_slab(self, reqs, idxs, qmax, tmax):
+    def _count_cells_packed_fused(self, reqs, idxs, qmax: int, iters: int,
+                                  R: int, n_slabs: int, n_slots: int):
+        """Padding accounting for one fused multi-chip WAVE (n_slabs
+        real slabs at uniform R, padded with dummy slabs to n_slots
+        chip-slots).  Dummy slabs freeze every segment at iteration 0 —
+        their chips idle rather than fill padding — so dispatched DP
+        cells count the REAL slabs only and the dummy-slot idleness is
+        read from fused_slot_fill instead of dp_row_fill."""
+        if self.metrics is None:
+            return
+        band = self.cfg.align.band
+        scale = qmax * band * iters
+        rows_real = int(sum(int(reqs[i].row_mask.sum()) for i in idxs))
+        real = band * iters * int(
+            sum(int(reqs[i].qlens[reqs[i].row_mask].sum()) for i in idxs))
+        padded = n_slabs * R * scale
+        self.metrics.dp_cells_padded += padded
+        self.metrics.dp_cells_real += real
+        self.metrics.dp_round_cells_padded += padded
+        self.metrics.dp_round_cells_real += real
+        self.metrics.dp_rowcells_real += rows_real * scale
+        self.metrics.dp_rowcells_cap += n_slabs * R * scale
+        self.metrics.dp_rows_real += rows_real
+        self.metrics.dp_rows_dispatched += n_slabs * R
+        self.metrics.packed_dispatches += 1
+        self.metrics.packed_holes += len(idxs)
+        self.metrics.fused_waves += 1
+        self.metrics.fused_slabs_real += n_slabs
+        self.metrics.fused_slots += n_slots
+
+    # ---- AOT warmup (pipeline/warmup.py): predict + precompile the
+    # ---- canonical packed executables concurrently with ingest/prep ----
+
+    def _warm_key(self, qmax, tmax, iters, R, dstack):
+        return ("refine_packed", qmax, tmax, iters, R, dstack)
+
+    def _warm_wait(self, key) -> None:
+        """Dispatch-side sync: cancel a still-queued warmup of this
+        shape (we compile inline, as without warmup) or wait out an
+        in-flight one (the compile is already running on the warmup
+        thread; waiting avoids a duplicate).  The builder's finally
+        guarantees the event fires."""
+        if self._warmup is not None:
+            ev = self._warmup.claim(key)
+            if ev is not None:
+                ev.wait()
+
+    def _note_shape(self, R, qmax, tmax, iters) -> None:
+        key = (R, qmax, tmax, iters)
+        if key not in self._shape_seen:
+            self._shape_seen.add(key)
+            if self.metrics is not None:
+                self.metrics.distinct_slab_shapes = len(self._shape_seen)
+
+    def warm_refine(self, req: RefineRequest, hole_id=None) -> None:
+        """Enqueue an AOT compile for the canonical executable this
+        request's (qmax, tmax, iters) group is predicted to need —
+        called by the driver the moment prep yields the request, so
+        cold XLA compiles overlap ingest/prep instead of stalling the
+        group's first dispatch.
+
+        The predicted R is the smallest canonical height covering the
+        group's ACCUMULATED predicted rows (capped at the budget — the
+        steady-state shape): warming every ladder height would book
+        compiles for programs never dispatched, which is exactly the
+        waste the canonical ladder exists to kill.  When accumulation
+        pushes the prediction up a height, the stale queued warm is
+        CANCELLED (WarmupCompiler.claim) — during an admission burst
+        the queue usually hasn't reached it yet, so most groups build
+        exactly one program.  No-op without a warmup compiler, under
+        --pass-buckets bucketed grouping or a GSPMD --mesh (their Z
+        bucket depends on the sweep size, unknowable at admission —
+        canonical slab shapes are what make the packed path
+        predictable)."""
+        if self._warmup is None or not self._packing:
+            return
+        qmax = req.qs.shape[1]
+        tmax = _fused_tmax(len(req.draft), self.len_quant)
+        gk = (qmax, tmax, req.iters)
+        rows = max(int(req.row_mask.sum()), pack_mod.SEG_DIV)
+        acc, old_r, old_key = self._group_pred.get(gk, (0, None, None))
+        # each hole counts ONCE per group: the driver re-warms every
+        # still-active hole after every sweep (a hole's next window is
+        # a fresh request), and re-adding the same hole's rows each
+        # sweep would walk a one-hole group's prediction up to the full
+        # budget — warming (and possibly cancelling/churning) programs
+        # its slabs never reach.  A hole entering a NEW group (its
+        # draft grew a bucket) legitimately counts there too.
+        if hole_id is not None:
+            seen = self._group_holes.setdefault(gk, set())
+            if hole_id in seen:
+                return
+            seen.add(hole_id)
+        acc = min(acc + rows, self.slab_rows)
+        R = self.slab_rows
+        for h in pack_mod.canonical_heights(self.slab_rows,
+                                            self.slab_ladder):
+            if h >= acc:
+                R = h
+            else:
+                break
+        dstack = (len(self._devices)
+                  if self._slab_mesh is not None else 1)
+        key = old_key
+        if R != old_r:
+            if old_key is not None:
+                self._warmup.claim(old_key)  # cancel the stale warm
+            H = max(1, R // pack_mod.SEG_DIV)
+            key = self._warm_key(qmax, tmax, req.iters, R, dstack)
+            self._warmup.submit(
+                key, functools.partial(self._warm_build, qmax, tmax,
+                                       req.iters, R, H, dstack))
+        if acc >= self.slab_rows:
+            # a group that fills its row budget lives long enough to
+            # DRIBBLE: late in the run the admission batch's windows
+            # finish in near-lockstep, sweeps shrink, and the group's
+            # tail waves snap to the lower canonical heights — each a
+            # fresh executable.  Warm those now (r08 scale trace:
+            # every group that crossed the budget later dispatched at
+            # budget/2), so the endgame transition books no inline
+            # compile.  Sweep-time warming cannot catch these — the
+            # dribble wave is planned microseconds before its own
+            # dispatch claims the key back.  Submit dedupes by key.
+            for h in pack_mod.canonical_heights(self.slab_rows,
+                                                self.slab_ladder):
+                if h != R:
+                    hH = max(1, h // pack_mod.SEG_DIV)
+                    self._warmup.submit(
+                        self._warm_key(qmax, tmax, req.iters, h, dstack),
+                        functools.partial(self._warm_build, qmax, tmax,
+                                          req.iters, h, hH, dstack))
+        self._group_pred[gk] = (acc, R, key)
+
+    def _warm_sweep_shapes(self, shapes) -> None:
+        """Sweep-time exact warming: by group-construction time the
+        sweep's slab plans are known EXACTLY, so submit any shape not
+        yet compiled before the dispatch-all loop starts — the warmup
+        thread then builds upcoming shapes (late-run dribble waves at
+        the lower canonical heights, mostly) while earlier groups
+        dispatch.  Unlike admission-time prediction this can never
+        build a program that is not about to be used; a shape whose
+        build has not started when its own dispatch arrives is claimed
+        back and compiled inline, exactly as without warmup."""
+        if self._warmup is None:
+            return
+        for qmax, tmax, iters, R, dstack in shapes:
+            H = max(1, R // pack_mod.SEG_DIV)
+            self._warmup.submit(
+                self._warm_key(qmax, tmax, iters, R, dstack),
+                functools.partial(self._warm_build, qmax, tmax, iters,
+                                  R, H, dstack),
+                urgent=True)
+
+    def _warm_build(self, qmax, tmax, iters, R, H, dstack) -> None:
+        """Warmup-thread builder: run the REAL jitted step on an all-
+        zero slab and block — the zero row mask freezes every segment,
+        so the while_loop exits at iteration 0 and the execution costs
+        ~a breakpoint scan; what it buys is the exact jit fast path
+        primed (fn.lower().compile() shares the XLA compile but leaves
+        a retrace + dispatch-cache miss on the first real call, which
+        would then book as execute time).  The warmup=True span books
+        the (group, shape)'s compile, so the first real dispatch books
+        as execute — the trace-visible proof the overlap worked."""
+        cfg = self.cfg
+        Lbig, Lsmall = _slab_wire_sizes(R, qmax, H, tmax,
+                                        cfg.max_ins_per_col)
+        group = f"packed:q{qmax}:t{tmax}:i{iters}"
+        if dstack > 1:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as PS
+
+            step = _refine_step_packed_fused(
+                cfg.align, cfg.max_ins_per_col, tmax, iters, H,
+                self._bp_consts(), (R, qmax), self._slab_mesh)
+            sharding = NamedSharding(self._slab_mesh, PS("slab", None))
+            with trace.device_span("warmup", group=group,
+                                   shape=f"D{dstack}:R{R}:S{H}",
+                                   warmup=True):
+                big = jax.device_put(
+                    np.zeros((dstack, Lbig), np.uint8), sharding)
+                small = jax.device_put(
+                    np.zeros((dstack, Lsmall), np.int32), sharding)
+                jax.block_until_ready(step(big, small))
+        else:
+            step = _refine_step_packed(
+                cfg.align, cfg.max_ins_per_col, tmax, iters, H,
+                self._bp_consts(), pack=(R, qmax))
+            with trace.device_span("warmup", group=group,
+                                   shape=f"R{R}:S{H}", warmup=True):
+                jax.block_until_ready(step(np.zeros(Lbig, np.uint8),
+                                           np.zeros(Lsmall, np.int32)))
+
+    def _stack_slab(self, reqs, idxs, qmax, tmax, shape=None):
         """Pack the real pass-rows of the given requests into ONE slab:
         (R, qmax) rows + (H, tmax) per-hole drafts + the row->hole
         segment vector.  Row order is idxs order (the packing plan's
         placement order — or a bisected half of it on the OOM-resplit
-        ladder, which re-packs at the smaller covering power of two)."""
+        ladder, which re-packs at the smaller covering canonical
+        slab).  ``shape`` forces (R, H) — the fused multi-chip path
+        stacks every slab of a wave at the wave's uniform shape."""
         rows = [int(reqs[i].row_mask.sum()) for i in idxs]
-        R, H = pack_mod.slab_shape(rows, self.slab_rows)
+        R, H = shape if shape is not None else pack_mod.slab_shape(
+            rows, self.slab_rows, ladder=self.slab_ladder)
         qs = np.zeros((R, qmax), np.uint8)
         qlens = np.zeros((R,), np.int32)
         row_mask = np.zeros((R,), bool)
@@ -1342,67 +1692,130 @@ class BatchExecutor:
             tmax = _fused_tmax(len(req.draft), self.len_quant)
             shape_groups[(qmax, tmax, req.iters)].append(i)
 
+        # one fused multi-chip dispatch per group per WAVE when >1 local
+        # device: D consecutive slabs of the plan stack on a leading
+        # device dim and run as ONE executable call over the ('slab',)
+        # mesh (_refine_step_packed_fused) — one transfer + one dispatch
+        # where the r7 round-robin issued one of each per slab per chip
+        # (and compiled one executable per chip).  Single device: one
+        # dispatch per slab, as before.  A wave (or slab) is also the
+        # recovery unit: its idxs are its HOLES, so the OOM rung bisects
+        # by hole and each half re-plans at the smaller covering
+        # canonical slab.
+        D = len(self._devices)
+        fused = self._slab_mesh is not None
+
+        def _plan_wave(idxs):
+            """Deterministic (plan, R, H) for a wave's holes — dispatch
+            and finish both re-derive it, so OOM-bisected halves stay
+            self-consistent.  All slabs of a wave share the wave's
+            largest canonical R (one executable per wave)."""
+            rows = [nrows[i] for i in idxs]
+            plan = pack_mod.plan_slabs(rows, self.slab_rows)
+            R = max(pack_mod.slab_shape([rows[j] for j in s],
+                                        self.slab_rows,
+                                        ladder=self.slab_ladder)[0]
+                    for s in plan)
+            return plan, R, max(1, R // pack_mod.SEG_DIV)
+
         groups: Dict[tuple, List[int]] = {}
+        sweep_shapes = set()
         for key, idxs in shape_groups.items():
             slabs = pack_mod.plan_slabs([nrows[i] for i in idxs],
                                         self.slab_rows)
-            for s_no, slab in enumerate(slabs):
-                groups[key + (s_no,)] = [idxs[j] for j in slab]
+            if fused:
+                for w in range(0, len(slabs), D):
+                    chunk = slabs[w:w + D]
+                    wave = [idxs[j] for s in chunk for j in s]
+                    wkey = key + (w // D,)
+                    groups[wkey] = wave
+                    _, R, _ = _plan_wave(wave)
+                    sweep_shapes.add(key + (R, D))
+                    self._count_cells_packed_fused(
+                        requests, wave, key[0], key[2], R,
+                        len(chunk), D)
+            else:
+                for s_no, slab in enumerate(slabs):
+                    sl_idxs = [idxs[j] for j in slab]
+                    groups[key + (s_no,)] = sl_idxs
+                    R, _ = pack_mod.slab_shape(
+                        [nrows[i] for i in sl_idxs], self.slab_rows,
+                        ladder=self.slab_ladder)
+                    sweep_shapes.add(key + (R, 1))
+                    self._count_cells_packed(requests, sl_idxs, key[0],
+                                             R, key[2])
+        self._warm_sweep_shapes(sweep_shapes)
 
         if self.metrics is not None:
             self.metrics.device_dispatches += len(groups)
-        for key, idxs in groups.items():
-            R, _ = pack_mod.slab_shape([nrows[i] for i in idxs],
-                                       self.slab_rows)
-            self._count_cells_packed(requests, idxs, key[0], R, key[2])
 
         def dispatch(idxs, key):
             qmax, tmax, iters, _ = key
-            args = self._stack_slab(requests, idxs, qmax, tmax)
             faultinject.fire("device_oom")
-            R = args[0].shape[0]
-            step = _refine_step_packed(
-                cfg.align, cfg.max_ins_per_col, tmax, iters,
-                args[4].shape[0], self._bp_consts(),
-                pack=(R, qmax))
-            big, small = _pack_slab_args(args)
-            if len(self._devices) > 1:
-                # slab-level data parallelism: each slab is an
-                # independent fused dispatch, so whole slabs round-robin
-                # across the local chips (committed inputs pin the jit
-                # execution) — no GSPMD partitioning, no cross-chip
-                # traffic, and the dispatch-all-then-finish sweep keeps
-                # every chip busy concurrently
-                dev = self._devices[self._slab_rr % len(self._devices)]
-                self._slab_rr += 1
-                big = jax.device_put(big, dev)
-                small = jax.device_put(small, dev)
-                # jit compiles one executable PER DEVICE: the first
-                # same-shape slab on each chip pays a compile, so the
-                # shape key carries the round-robin target
-                dtag = f":d{self._devices.index(dev)}"
-            else:
-                dtag = ""
+            band = cfg.align.band
+            if not fused:
+                args = self._stack_slab(requests, idxs, qmax, tmax)
+                R = args[0].shape[0]
+                H = args[4].shape[0]
+                big, small = _pack_slab_args(args, cfg.max_ins_per_col)
+                self._warm_wait(self._warm_key(qmax, tmax, iters, R, 1))
+                self._note_shape(R, qmax, tmax, iters)
+                step = _refine_step_packed(
+                    cfg.align, cfg.max_ins_per_col, tmax, iters, H,
+                    self._bp_consts(), pack=(R, qmax))
+                with trace.device_span(
+                        "refine_packed",
+                        group=f"packed:q{qmax}:t{tmax}:i{iters}",
+                        cells=R * qmax * band * iters,
+                        shape=f"R{R}:S{H}",
+                        plan={"slab": key[3], "rows": R,
+                              "holes": len(idxs)}) as sp:
+                    faultinject.fire("stall")
+                    return sp.force(step(big, small))
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as PS
+
+            plan, R, H = _plan_wave(idxs)
+            # an OOM-resplit re-plan can exceed D slabs; K > 1 then
+            # carries K slabs per chip — still one executable call
+            K = -(-len(plan) // D)
+            Lbig, Lsmall = _slab_wire_sizes(R, qmax, H, tmax,
+                                            cfg.max_ins_per_col)
+            bigs = np.zeros((K * D, Lbig), np.uint8)
+            smalls = np.zeros((K * D, Lsmall), np.int32)
+            for d, s in enumerate(plan):
+                args = self._stack_slab(requests, [idxs[j] for j in s],
+                                        qmax, tmax, shape=(R, H))
+                bigs[d], smalls[d] = _pack_slab_args(
+                    args, cfg.max_ins_per_col)
+            # dummy tail slabs stay all-zero: an empty row mask freezes
+            # every segment, so that chip exits the while_loop at
+            # iteration 0
+            self._warm_wait(self._warm_key(qmax, tmax, iters, R, K * D))
+            self._note_shape(R, qmax, tmax, iters)
+            step = _refine_step_packed_fused(
+                cfg.align, cfg.max_ins_per_col, tmax, iters, H,
+                self._bp_consts(), (R, qmax), self._slab_mesh)
+            sharding = NamedSharding(self._slab_mesh, PS("slab", None))
             with trace.device_span(
                     "refine_packed",
                     group=f"packed:q{qmax}:t{tmax}:i{iters}",
-                    cells=R * qmax * cfg.align.band * iters,
-                    shape=f"R{R}:S{args[4].shape[0]}{dtag}",
-                    plan={"slab": key[3], "rows": R,
+                    cells=len(plan) * R * qmax * band * iters,
+                    shape=f"D{K * D}:R{R}:S{H}",
+                    plan={"wave": key[3], "slabs": len(plan),
+                          "chips": D, "rows": R,
                           "holes": len(idxs)}) as sp:
                 faultinject.fire("stall")
+                big = jax.device_put(bigs, sharding)
+                small = jax.device_put(smalls, sharding)
                 return sp.force(step(big, small))
 
-        def finish(idxs, key, out):
-            qmax, tmax, iters, _ = key
-            R, H = pack_mod.slab_shape([nrows[i] for i in idxs],
-                                       self.slab_rows)
+        def _finish_slab(sl_idxs, tmax, big, small, R, H):
             (cons, ins_base, ins_votes, ncov, nwin, bp, advance, dlen,
-             ovf) = _unpack_slab_refine(
-                np.asarray(out[0]), np.asarray(out[1]),
-                cfg.max_ins_per_col, tmax, H, R)
+             ovf) = _unpack_slab_refine(big, small,
+                                        cfg.max_ins_per_col, tmax, H, R)
             r0 = 0
-            for s, i in enumerate(idxs):
+            for s, i in enumerate(sl_idxs):
                 req = requests[i]
                 n = nrows[i]
                 rows = slice(r0, r0 + n)
@@ -1425,6 +1838,20 @@ class BatchExecutor:
                     tlen=int(dlen[s]), bp=int(bp[s]), advance=adv,
                 )
                 results[i] = RefineResult(rr=rr)
+
+        def finish(idxs, key, out):
+            qmax, tmax, iters, _ = key
+            big, small = np.asarray(out[0]), np.asarray(out[1])
+            if not fused:
+                R, H = pack_mod.slab_shape(
+                    [nrows[i] for i in idxs], self.slab_rows,
+                    ladder=self.slab_ladder)
+                _finish_slab(idxs, tmax, big, small, R, H)
+                return
+            plan, R, H = _plan_wave(idxs)
+            for d, s in enumerate(plan):
+                _finish_slab([idxs[j] for j in s], tmax,
+                             big[d], small[d], R, H)
 
         self._run_groups(groups, dispatch, finish, host_one, results,
                          label=lambda k: f"packed:q{k[0]}:t{k[1]}:i{k[2]}")
@@ -1502,9 +1929,23 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
     # a non-positive in-flight window would make the admission condition
     # permanently false and spin the scheduler forever
     inflight = max(1, int(inflight))
-    executor = BatchExecutor(cfg, metrics=metrics)
+    # AOT warmup precompiler (--no-warmup disables): as soon as prep
+    # yields a hole's first RefineRequest, the group's canonical
+    # executables compile on this background thread, concurrently with
+    # ingest/prep — the first dispatch of a warmed shape then runs at
+    # steady-state speed (and books as execute in the tracer)
+    warm = None
+    if getattr(cfg, "warmup_compile", True):
+        from ccsx_tpu.pipeline.warmup import WarmupCompiler
+
+        warm = WarmupCompiler()
+    executor = BatchExecutor(cfg, metrics=metrics, warmup=warm)
     pair_executor = PairExecutor(cfg.align, quant=cfg.len_bucket_quant,
-                                 metrics=metrics)
+                                 metrics=metrics, warmup=warm)
+
+    def warm_hole(h) -> None:
+        if warm is not None and isinstance(h.req, RefineRequest):
+            executor.warm_refine(h.req, hole_id=h.idx)
     resume = journal.holes_done
     put_at = getattr(writer, "put_at", None)
 
@@ -1593,6 +2034,7 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
                 if h.done:
                     finished[h.idx] = h
                 else:
+                    warm_hole(h)
                     active.append(h)
             emit_ready()
             if not active:
@@ -1625,6 +2067,9 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
                 if h.done:
                     finished[h.idx] = h
                 else:
+                    # a sweep can grow a hole's draft into a fresh
+                    # (qmax, tmax) group — predict next wave's shapes
+                    warm_hole(h)
                     still.append(h)
             active = still
             emit_ready()
@@ -1643,6 +2088,11 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
         # settle the (possibly rate-limit-lagging) cursor AFTER the
         # writer has made the records durable
         journal.close()
+        # stop the warmup thread (drops queued compiles; an in-flight
+        # build finishes) BEFORE the tracer closes, so no warmup span
+        # outlives the trace file
+        if warm is not None:
+            warm.close()
         # stop the watchdog + export the trace BEFORE the final metrics
         # event, so a degraded mark set mid-run is in the "final"
         trace.uninstall()

@@ -34,10 +34,17 @@ Packing discipline (all deterministic — same inputs, same plan):
   packing constraint, not a truncation: plan_slabs never assigns more
   than H holes to a slab.
 * the LAST slab of a group (and every slab re-packed by the OOM-resplit
-  ladder, pipeline/batch._recover_group) shrinks to the smallest rung
-  of a bounded ladder that fits — budget/8 multiples, pow2 below that
-  (see slab_shape) — so tail slabs reuse a small cached shape set
-  instead of costing fresh XLA programs at steady state.
+  ladder, pipeline/batch._recover_group) snaps to the smallest of at
+  most ``ladder`` CANONICAL heights that fits — budget, budget/2, ...
+  (see slab_shape) — so a (qmax, tmax, iters) group compiles at most
+  ``ladder`` XLA programs ever.  The r7 flight recorder measured the
+  finer budget/8 ladder paying 4-5 compiles per packed group (one per
+  distinct tail R) — through a tens-of-seconds-per-shape compiler that
+  ladder bought back its tail-waste savings many times over, so r8
+  collapses it: worst-case tail waste rises to just under budget/2
+  rows of masked (cheap, but dispatched) fill, and the shape set per
+  group drops from <=12 to <=2, each precompilable by the AOT warmup
+  thread (pipeline/warmup.py) before the first dispatch needs it.
 """
 
 from __future__ import annotations
@@ -53,6 +60,10 @@ import numpy as np
 # simply opens another slab.
 SEG_DIV = 4
 
+# canonical tail heights per group: budget and budget/2 (cfg
+# slab_shape_ladder / --slab-shape-ladder; 1 = every slab full-height)
+DEFAULT_LADDER = 2
+
 
 def pow2(n: int) -> int:
     """Smallest power of two >= max(n, 1)."""
@@ -62,32 +73,47 @@ def pow2(n: int) -> int:
     return p
 
 
+def canonical_heights(slab_rows: int, ladder: int = DEFAULT_LADDER) -> list:
+    """The allowed slab row counts at or below the budget: budget >> k
+    for k in [0, ladder), descending, never below 1.  ladder=1 means
+    every slab dispatches full-height; the default 2 adds budget/2 for
+    small tails.  Oversize holes (rows > budget) still grow past the
+    budget on the pow2 ladder — they get dedicated slabs and are the
+    only way a group can exceed ``ladder`` distinct shapes."""
+    budget = pow2(max(1, slab_rows))
+    return [max(1, budget >> k) for k in range(max(1, int(ladder)))]
+
+
 def slab_shape(rows: Sequence[int], slab_rows: int,
-               seg_div: int = SEG_DIV) -> tuple:
+               seg_div: int = SEG_DIV,
+               ladder: int = DEFAULT_LADDER) -> tuple:
     """(R, H) device shape for ONE slab holding holes with ``rows`` real
     rows each.
 
     R covers the row total, the segment capacity floor (seg_div rows per
     hole slot keeps H = R // seg_div >= len(rows)), and the largest
-    single hole; full slabs land exactly on pow2(slab_rows) and
-    oversize holes grow past it on the pow2 ladder.  PARTIAL slabs
-    (group tails, OOM-resplit halves) shrink on a FINER ladder:
-    multiples of budget/8 down to budget/8, then powers of two below
-    that.  The late scheduler sweeps of a run dribble only a few
-    windows per shape group, so most slabs are partial — pow2-only
-    shrinking measured ~25% average tail waste (dp_row_fill 0.72 on
-    the 64-hole CPU scale config), while the 8-step ladder holds the
-    worst case to budget/8 - 1 rows at a still-bounded shape count
-    (<= 12 R values per (qmax, tmax) group, all cached)."""
+    single hole; oversize holes grow past the budget on the pow2
+    ladder.  Everything else SNAPS UP to the smallest of the
+    ``ladder`` canonical heights (canonical_heights) that covers it —
+    at most 2 distinct XLA programs per (qmax, tmax, iters) group by
+    default, each predictable (and so AOT-warmable) before any slab of
+    the group exists.  The r7 budget/8 shrink ladder held tail waste
+    under budget/8 rows but paid 4-5 compiles per group (trace-
+    measured, BENCH r7) — masked tail rows are cheap fill, compiles
+    are tens of seconds each, so the trade inverts."""
     if not rows:
         raise ValueError("empty slab")
     budget = pow2(max(1, slab_rows))
-    quant = max(1, budget // 8)
     need = max(sum(rows), seg_div * len(rows), max(rows))
-    if need >= budget or need <= quant:
+    if need > budget:
         R = pow2(need)
     else:
-        R = -(-need // quant) * quant
+        R = budget
+        for h in canonical_heights(slab_rows, ladder):
+            if h >= need:
+                R = h
+            else:
+                break
     return R, max(1, R // seg_div)
 
 

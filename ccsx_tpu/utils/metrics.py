@@ -72,6 +72,20 @@ class Metrics:
     dp_rows_dispatched: int = 0
     packed_dispatches: int = 0
     packed_holes: int = 0
+    # compile-lean dispatch (r8): distinct (R, qmax, tmax, iters) slab
+    # shapes the packed executor dispatched — the canonical-shape ladder
+    # (pipeline/pack.py) bounds this to ~ladder x groups, and the r7
+    # compile storm showed up here as ~5x groups.  The executor owns the
+    # set; this is its size.
+    distinct_slab_shapes: int = 0
+    # fused multi-chip packed dispatch: waves issued, real slabs in
+    # them, and total chip-slots (waves x D) — fused_slot_fill below is
+    # the chip-utilization analog of dp_row_fill (idle chips in a wave
+    # are padding dummy slabs that freeze at iteration 0, so they cost
+    # ~nothing but chip time)
+    fused_waves: int = 0
+    fused_slabs_real: int = 0
+    fused_slots: int = 0
     # compressed input bytes this process ingested (byte-range sharded
     # BAM ingest reports its ~1/N share; full-parse paths report the
     # file size).  0 when unknown (stdin / pure-stream inputs).
@@ -182,6 +196,11 @@ class Metrics:
                                                2)
                                          if self.packed_dispatches
                                          else None,
+            "distinct_slab_shapes": self.distinct_slab_shapes or None,
+            "fused_waves": self.fused_waves or None,
+            "fused_slot_fill": round(self.fused_slabs_real
+                                     / self.fused_slots, 4)
+                               if self.fused_slots else None,
             "ingest_bytes": self.ingest_bytes,
             "ingest_s": round(self.t_ingest, 6),
             "prep_s": round(self.t_prep, 6),
@@ -193,6 +212,15 @@ class Metrics:
         if self.group_stats:
             snap["groups"] = self._group_table()
             snap["groups_forced"] = bool(self.groups_forced)
+            # compile share of wall: how much of this run's elapsed
+            # time went to XLA compiles (warmup-thread compiles overlap
+            # the stream, so a healthy warmed run shows compile_s high
+            # but compile blocking ~nothing — compare against the
+            # per-group tables; dict() copy: watchdog-thread safety)
+            comp = sum(st.get("compile_s", 0.0)
+                       for st in dict(self.group_stats).values())
+            snap["compile_s"] = round(comp, 4)
+            snap["compile_share"] = round(comp / self.elapsed, 4)
         if self.degraded:
             snap["degraded"] = self.degraded
         return snap

@@ -277,7 +277,8 @@ class Tracer:
     @contextlib.contextmanager
     def device_span(self, name: str, group: Optional[str] = None,
                     cells: int = 0, plan=None, shape=None,
-                    attribute: bool = True, **args):
+                    attribute: bool = True, warmup: bool = False,
+                    **args):
         """A device-dispatch span: watchdog-registered while open,
         compile/execute-attributed at close.  ``group`` keys the
         attribution table; ``cells`` is the dispatched DP cell count
@@ -300,7 +301,18 @@ class Tracer:
         wait must itself be a device span or the watchdog is blind to
         exactly the r5 dead-tunnel hang.  Attribution convention: only
         records carrying a "compile" key (true or false) enter group
-        tables — failed and attribute=False spans carry none."""
+        tables — failed and attribute=False spans carry none.
+
+        ``warmup=True`` marks an AOT precompile span (pipeline/
+        warmup.py): it consumes the (group, shape)'s compile slot — so
+        the first REAL dispatch of a warmed shape books as execute,
+        the trace-visible proof the compile overlapped the stream —
+        and books compiles/compile_s in the group table WITHOUT
+        counting a dispatch or cells (nothing was dispatched for a
+        consumer).  A warmup span for an already-seen shape books
+        nothing.  Warmup records carry top-level "warmup": true next
+        to the "compile" key; the stats re-derivation applies the same
+        rule (summarize)."""
         a = dict(args)
         key = group or name
         a["group"] = key
@@ -349,19 +361,29 @@ class Tracer:
                         "compiles": 0, "compile_s": 0.0,
                         "execute_s": 0.0, "dispatches": 0,
                         "dp_cells": 0, "exec_cells": 0})
-                    st["dispatches"] += 1
-                    st["dp_cells"] += int(cells or 0)
-                    if first:
-                        # first call of a (group, shape) = XLA trace +
-                        # compile + execute; later calls are
-                        # steady-state execute
-                        st["compiles"] += 1
-                        st["compile_s"] += dur
+                    if warmup:
+                        # AOT precompile: books the shape's one compile,
+                        # no dispatch/cells; a redundant warmup of a
+                        # seen shape books nothing at all
+                        if first:
+                            st["compiles"] += 1
+                            st["compile_s"] += dur
                     else:
-                        st["execute_s"] += dur
-                        st["exec_cells"] += int(cells or 0)
+                        st["dispatches"] += 1
+                        st["dp_cells"] += int(cells or 0)
+                        if first:
+                            # first call of a (group, shape) = XLA trace
+                            # + compile + execute; later calls are
+                            # steady-state execute
+                            st["compiles"] += 1
+                            st["compile_s"] += dur
+                        else:
+                            st["execute_s"] += dur
+                            st["exec_cells"] += int(cells or 0)
             if failed or not attribute:
                 rec = self._span_rec(sp, dur)
+            elif warmup:
+                rec = self._span_rec(sp, dur, compile=first, warmup=True)
             else:
                 rec = self._span_rec(sp, dur, compile=first)
             if self_s < dur - 1e-9:
@@ -472,11 +494,12 @@ def span(name: str, cat: str = "host", **args):
 
 
 def device_span(name: str, group: Optional[str] = None, cells: int = 0,
-                plan=None, **args):
+                plan=None, warmup: bool = False, **args):
     t = _current
     if t is None:
         return _null_ctx()
-    return t.device_span(name, group=group, cells=cells, plan=plan, **args)
+    return t.device_span(name, group=group, cells=cells, plan=plan,
+                         warmup=warmup, **args)
 
 
 def instant(name: str, cat: str = "host", **args) -> None:
@@ -538,6 +561,8 @@ def export_chrome(path: str) -> str:
                 args = dict(rec.get("args", {}))
                 if rec.get("compile"):
                     args["compile"] = True
+                if rec.get("warmup"):
+                    args["warmup"] = True
                 emit({
                     "ph": "X", "name": rec["name"], "cat": rec["cat"],
                     "ts": round(rec["mono"] * 1e6, 3),
@@ -655,6 +680,14 @@ def summarize(paths, top: int = 10) -> dict:
                 st = groups.setdefault(key, {
                     "compiles": 0, "compile_s": 0.0, "execute_s": 0.0,
                     "dispatches": 0, "dp_cells": 0, "exec_cells": 0})
+                if sp.get("warmup"):
+                    # AOT warmup span (pipeline/warmup.py): the shape's
+                    # compile, no dispatch — same rule device_span
+                    # applied to Metrics.group_stats
+                    if sp["compile"]:
+                        st["compiles"] += 1
+                        st["compile_s"] += sp["dur"]
+                    continue
                 st["dispatches"] += 1
                 cells = int(sp.get("args", {}).get("cells", 0))
                 st["dp_cells"] += cells
@@ -715,6 +748,21 @@ def format_summary(d: dict) -> str:
                 f"{st['compile_s']:>10.4f} {st['execute_s']:>10.4f} "
                 f"{st['dispatches']:>6} {st['dp_cells']:>14} "
                 f"{cps if cps is not None else '-':>12}")
+        # compile-storm guard (the r7 finding: packed groups paying 4-5
+        # compiles each, one per distinct tail-slab R, invisible until
+        # traced).  Canonical slab shapes bound a packed group to the
+        # ladder size (default 2, --slab-shape-ladder); anything above
+        # 1 deserves eyes, anything above 2 is the storm come back
+        storms = {k: st["compiles"] for k, st in d["groups"].items()
+                  if st["compiles"] > 1}
+        if storms:
+            worst = max(storms.items(), key=lambda kv: kv[1])
+            bang = "!!" * 10 if worst[1] > 2 else "!!"
+            lines.append(
+                f"  {bang} compiles>1 in steady state: {len(storms)} "
+                f"group(s) recompiled (worst {worst[0]} x{worst[1]}) — "
+                "canonical-ladder budget is 2 (--slab-shape-ladder); "
+                f">2 means the r7 compile storm is back {bang}")
     if d["stage_seconds"]:
         lines.append("stage breakdown (span self-seconds by category; "
                      "nested children excluded):")

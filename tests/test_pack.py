@@ -24,13 +24,27 @@ def test_slab_shape_full_slab_lands_on_budget():
     assert (R, H) == (64, 16)
 
 
-def test_slab_shape_tail_shrinks_down_bounded_ladder():
-    """Partial slabs shrink to budget/8 multiples (pow2 below that) —
-    a small cached shape set, with worst-case tail waste < budget/8."""
-    assert pack.slab_shape([5, 6], 64) == (16, 4)   # 11 -> 2 quanta
-    assert pack.slab_shape([3], 64) == (4, 1)       # below quant: pow2
-    assert pack.slab_shape([10, 9, 9, 9], 64) == (40, 10)  # not pow2 48+
-    assert pack.slab_shape([30, 20], 128) == (64, 16)  # quant 16
+def test_slab_shape_tail_snaps_to_canonical_heights():
+    """Partial slabs snap UP to the smallest of the <= ladder canonical
+    heights (budget >> k) that fits — at most 2 XLA programs per shape
+    group by default, vs the 4-5 the r7 budget/8 ladder paid (the
+    compile storm the flight recorder caught)."""
+    assert pack.canonical_heights(64) == [64, 32]
+    assert pack.slab_shape([5, 6], 64) == (32, 8)    # 11 -> budget/2
+    assert pack.slab_shape([3], 64) == (32, 8)       # tiny tail: same
+    assert pack.slab_shape([10, 9, 9, 9], 64) == (64, 16)  # 37 > 32
+    assert pack.slab_shape([30, 20], 128) == (64, 16)      # 50 -> 64
+
+
+def test_slab_shape_ladder_knob():
+    """ladder=1 forces every slab full-height (one program per group);
+    deeper ladders add halvings for row-fill-sensitive runs."""
+    assert pack.canonical_heights(64, ladder=1) == [64]
+    assert pack.slab_shape([3], 64, ladder=1) == (64, 16)
+    assert pack.canonical_heights(64, ladder=3) == [64, 32, 16]
+    assert pack.slab_shape([3], 64, ladder=3) == (16, 4)
+    # ladder never walks below one row
+    assert pack.canonical_heights(2, ladder=4) == [2, 1, 1, 1]
 
 
 def test_slab_shape_capacity_floor():
@@ -39,7 +53,7 @@ def test_slab_shape_capacity_floor():
     rows = [1] * 10
     R, H = pack.slab_shape(rows, 64)
     assert H >= len(rows)
-    assert R == 40  # seg floor 4*10, rounded to the 8-row quant
+    assert R == 64  # seg floor 4*10 = 40 snaps up to the budget
 
 
 def test_slab_shape_oversize_hole_grows_R():

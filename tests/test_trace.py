@@ -385,6 +385,14 @@ def test_traced_run_group_table_matches_spans(traced_run):
                              {"compiles": 0, "compile_s": 0.0,
                               "execute_s": 0.0, "dispatches": 0,
                               "dp_cells": 0})
+        if r.get("warmup"):
+            # AOT warmup span (pipeline/warmup.py): books the shape's
+            # compile, never a dispatch — the same rule device_span
+            # and stats' summarize() apply
+            if r.get("compile"):
+                st["compiles"] += 1
+                st["compile_s"] += r["dur"]
+            continue
         st["dispatches"] += 1
         st["dp_cells"] += r["args"].get("cells", 0)
         if r.get("compile"):
