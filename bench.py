@@ -444,6 +444,16 @@ def _inner_main():
         # compile/execute table rides each e2e entry below, so the
         # bench artifact carries its own attribution evidence
         trace_prefix = os.environ.get("CCSX_BENCH_TRACE")
+        # CCSX_BENCH_TELEMETRY=<port>: serve the live telemetry plane
+        # during each e2e config, so a long battery is watchable with
+        # `ccsx-tpu top host:<port>` instead of being a black box until
+        # its JSON line lands (configs run sequentially, so one port
+        # serves them all; the server auto-bumps if it is held)
+        try:
+            telemetry_port = int(
+                os.environ.get("CCSX_BENCH_TELEMETRY", "0") or 0)
+        except ValueError:
+            telemetry_port = 0
         results = []
         for cfg in (1, 2, 3, 4, 5):
             if time.monotonic() > deadline:
@@ -454,7 +464,8 @@ def _inner_main():
                 r = e2e_mod.run_config(
                     cfg, holes, "auto",
                     trace_path=(f"{trace_prefix}.c{cfg}.jsonl"
-                                if trace_prefix else None))
+                                if trace_prefix else None),
+                    telemetry_port=telemetry_port)
                 results.append({k: r.get(k) for k in (
                     "config", "backend", "holes_in", "holes_out",
                     "zmws_per_sec", "dp_row_fill",

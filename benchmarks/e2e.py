@@ -80,7 +80,8 @@ def make_input(config: int, n_holes: int, rng, tmp):
 
 def run_config(config: int, n_holes: int, batch: str, seed: int = 0,
                trace_path: str = None,
-               stall_timeout: float = None) -> dict:
+               stall_timeout: float = None,
+               telemetry_port: int = None) -> dict:
     rng = np.random.default_rng(seed)
     with tempfile.TemporaryDirectory() as tmp:
         in_path, args, zs = make_input(config, n_holes, rng, tmp)
@@ -91,6 +92,10 @@ def run_config(config: int, n_holes: int, batch: str, seed: int = 0,
             extra += ["--trace", trace_path]
         if stall_timeout is not None:
             extra += ["--stall-timeout", str(stall_timeout)]
+        if telemetry_port:
+            # live endpoints while the bench runs (an operator can
+            # `ccsx-tpu top host:port` a long battery mid-flight)
+            extra += ["--telemetry-port", str(telemetry_port)]
         t0 = time.perf_counter()
         rc = cli.main([*args, "--batch", batch, "--metrics", mpath,
                        *extra, in_path, out])

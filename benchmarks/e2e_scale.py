@@ -90,17 +90,80 @@ def round_metric_inline(backend_ready: bool = True) -> dict:
             "measure_seconds": round(time.perf_counter() - t0, 1)}
 
 
+def _scrape_progress(port: int, stop, samples: list) -> None:
+    """Poll the run's /progress endpoint (tolerating the auto-bump
+    window above the requested port) once a second into ``samples`` —
+    the live-ETA series the artifact's eta_accuracy recap grades."""
+    import urllib.request
+
+    from ccsx_tpu.utils.telemetry import PORT_TRIES
+
+    while not stop.is_set():
+        # cover the server's whole auto-bump window, or a busy base
+        # port silently yields zero ETA samples
+        for p in range(port, port + PORT_TRIES):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{p}/progress",
+                        timeout=0.5) as r:
+                    snap = json.loads(r.read().decode())
+                samples.append(snap.get("progress") or {})
+                break
+            except (OSError, ValueError):
+                continue
+        stop.wait(1.0)
+
+
+def eta_accuracy(samples: list, actual_s: float):
+    """Grade the live ETA against the actual wall: for every scrape
+    that carried an ETA, |predicted finish - actual| / actual."""
+    errs = sorted(
+        abs((s["elapsed_s"] + s["eta_s"]) - actual_s) / actual_s
+        for s in samples
+        if s.get("eta_s") is not None and s.get("elapsed_s") is not None)
+    if not errs:
+        return None
+    return {"eta_samples": len(errs),
+            "median_abs_err_pct": round(errs[len(errs) // 2] * 100, 2),
+            "worst_abs_err_pct": round(errs[-1] * 100, 2)}
+
+
 def run_scale(n_holes: int, inflight: int, rng, device: str = "auto",
-              tlen_lo=1000, tlen_hi=5000, cli_extra=()):
+              tlen_lo=1000, tlen_hi=5000, cli_extra=(),
+              telemetry_port: int = 0):
+    import threading
+
+    from ccsx_tpu.io import bamindex
+
     with tempfile.TemporaryDirectory() as tmp:
         in_path = os.path.join(tmp, "big.bam")
         zs = make_big_bam(in_path, n_holes, rng, tlen_lo, tlen_hi)
+        # BGZF hole index sidecar: gives the run a knowable holes_total,
+        # so the progress estimator reports pct/ETA (not rate-only) and
+        # the report's ETA-vs-actual curve has data
+        bamindex.build_index(in_path)
         out = os.path.join(tmp, "out.fa")
         mpath = os.path.join(tmp, "m.jsonl")
+        extra = list(cli_extra)
+        samples: list = []
+        stop = threading.Event()
+        scraper = None
+        if telemetry_port:
+            extra += ["--telemetry-port", str(telemetry_port)]
+            scraper = threading.Thread(
+                target=_scrape_progress,
+                args=(telemetry_port, stop, samples), daemon=True)
         t0 = time.perf_counter()
-        rc = cli.main(["--batch", "on", "--inflight", str(inflight),
-                       "--metrics", mpath, "--device", device,
-                       *cli_extra, in_path, out])
+        if scraper is not None:
+            scraper.start()
+        try:
+            rc = cli.main(["--batch", "on", "--inflight", str(inflight),
+                           "--metrics", mpath, "--device", device,
+                           *extra, in_path, out])
+        finally:
+            stop.set()
+            if scraper is not None:
+                scraper.join(timeout=5.0)
         dt = time.perf_counter() - t0
         assert rc == 0, f"rc={rc}"
         got = {r.name: r.seq for r in fastx.read_fastx(out)}
@@ -112,9 +175,16 @@ def run_scale(n_holes: int, inflight: int, rng, device: str = "auto",
                     enc.encode(got[k]), z.template))
         final = [json.loads(line) for line in open(mpath)][-1]
         assert final["event"] == "final"
+        telemetry = None
+        if telemetry_port:
+            telemetry = {"port": telemetry_port,
+                         "scrapes": len(samples),
+                         "eta_accuracy": eta_accuracy(
+                             samples, final["elapsed_s"])}
         import jax
 
         return {
+            "telemetry": telemetry,
             "backend": jax.default_backend(),
             "holes_in": n_holes,
             "holes_out": len(got),
@@ -161,6 +231,11 @@ def run_scale(n_holes: int, inflight: int, rng, device: str = "auto",
             # evidence that the numbers are chip time, not RPC pings
             "groups": final.get("groups"),
             "degraded": final.get("degraded"),
+            # resource gauges (r9): the OOM-ladder postmortems now have
+            # a memory signal in every artifact
+            "peak_rss_bytes": final.get("peak_rss_bytes"),
+            "device_buffer_bytes": final.get("device_buffer_bytes"),
+            "holes_filtered": final.get("holes_filtered"),
             "mean_identity": round(float(np.mean(idys)), 5) if idys else None,
         }
 
@@ -199,6 +274,12 @@ def main():
                     dest="stall_timeout",
                     help="forwarded to the CLI: hang-watchdog timeout "
                          "seconds [CLI default 120]")
+    ap.add_argument("--telemetry-port", type=int, default=0,
+                    dest="telemetry_port",
+                    help="serve the live telemetry plane during the "
+                         "run AND scrape /progress from this process: "
+                         "the artifact embeds the scraped-ETA accuracy "
+                         "vs the actual wall (0 = off)")
     ap.add_argument("--json", default=None)
     a = ap.parse_args()
     tlen_lo, tlen_hi = (int(x) for x in a.tlen.split(","))
@@ -230,8 +311,11 @@ def main():
     if a.trace:
         scale_extra = extra + ("--trace", a.trace)
         res["trace"] = a.trace
+    if a.telemetry_port:
+        res["telemetry_port"] = a.telemetry_port
     res["scale"] = run_scale(a.holes, a.inflight, rng, a.device,
-                             tlen_lo, tlen_hi, scale_extra)
+                             tlen_lo, tlen_hi, scale_extra,
+                             telemetry_port=a.telemetry_port)
     if not a.skip_round:
         rm = res["round_metric"]["zmw_windows_per_sec"]
         ew = res["scale"]["zmw_windows_per_sec"]

@@ -51,6 +51,12 @@ TPU extensions (long options):
                            shape group and marks the run degraded;
                            first-of-shape dispatches get 10x the budget
                            for cold compiles; 0 disables) [120]
+--telemetry-port <port>   (live telemetry endpoints for the run: GET
+                           /metrics Prometheus text, /healthz
+                           ok|degraded incl. stall/fallback detail,
+                           /progress JSON with the windowed-rate ETA;
+                           auto-bumps when taken, per-rank offset under
+                           --hosts; 0 = off) [0]
 --hosts <int> --host-id <int> --coordinator <addr> --merge-shards <N>
 --merge-unmarked          (merge a legacy shard set without .done markers)
 --make-index              (index INPUT for byte-range sharded ingest)
@@ -71,6 +77,16 @@ ccsx-tpu stats <jsonl>... (summarize --trace / --metrics artifacts:
                            shape-group attribution table, stage
                            breakdown, occupancy recap, slowest
                            dispatches; any mix of files)
+ccsx-tpu top <src>...     (live ANSI dashboard over telemetry
+                           endpoints host:port and/or --metrics JSONL
+                           files; multi-rank sources aggregate —
+                           counters sum, min progress, any-degraded;
+                           --once for one frame)
+ccsx-tpu report <jsonl>.. (self-contained HTML run report from trace/
+                           metrics JSONL: timeline strip, group
+                           compile/execute table, stage breakdown,
+                           occupancy tiles, stall/recovery log,
+                           ETA-vs-actual curve; -o <out.html>)
 """
 
 
@@ -176,6 +192,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "degraded (0 disables; the first dispatch of "
                         "each shape gets 10x this budget — cold XLA "
                         "compiles are not hangs) [120]")
+    p.add_argument("--telemetry-port", type=int, default=0,
+                   dest="telemetry_port", metavar="PORT",
+                   help="Serve live telemetry for this run on a daemon "
+                        "thread: GET /metrics (Prometheus text), "
+                        "/healthz (ok|degraded + stall/fallback "
+                        "detail), /progress (JSON, windowed-rate ETA). "
+                        "The port auto-bumps when taken; sharded runs "
+                        "offset per rank.  0 = off [0]")
     p.add_argument("--profile", default=None,
                    help="Write a jax.profiler trace to this directory")
     # multi-host (parallel/distributed.py): run one process per host with
@@ -264,6 +288,11 @@ def config_from_args(args) -> CcsConfig:
         print(f"Error: --stall-timeout must be >= 0, got "
               f"{stall_timeout}", file=sys.stderr)
         raise SystemExit(1)
+    telemetry_port = getattr(args, "telemetry_port", 0) or 0
+    if not 0 <= telemetry_port <= 65535:
+        print(f"Error: --telemetry-port must be in [0, 65535], got "
+              f"{telemetry_port}", file=sys.stderr)
+        raise SystemExit(1)
     return CcsConfig(
         min_subread_len=args.min_len,
         max_subread_len=args.max_len,
@@ -283,6 +312,7 @@ def config_from_args(args) -> CcsConfig:
         metrics_path=args.metrics,
         trace_path=getattr(args, "trace", None),
         stall_timeout_s=stall_timeout,
+        telemetry_port=telemetry_port,
         # an explicit bucket list selects the bucketed-grouping control
         # path; the default is ragged pass packing (pipeline/pack.py)
         pass_packing=pass_buckets is None,
@@ -303,6 +333,16 @@ def main(argv: Optional[list] = None) -> int:
         from ccsx_tpu.utils import trace as trace_mod
 
         return trace_mod.stats_main(argv[1:])
+    if argv and argv[0] == "top":
+        # live telemetry dashboard (same no-jax discipline as stats)
+        from ccsx_tpu.utils import telemetry
+
+        return telemetry.top_main(argv[1:])
+    if argv and argv[0] == "report":
+        # static HTML run report from trace/metrics JSONL artifacts
+        from ccsx_tpu.utils import report as report_mod
+
+        return report_mod.report_main(argv[1:])
     args = build_parser().parse_args(argv)
     if args.help:
         return usage()  # rc 1, like the reference (main.c:761)

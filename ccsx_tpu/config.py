@@ -166,6 +166,13 @@ class CcsConfig:
     stall_timeout_s: float = 120.0      # CLI --stall-timeout: the hang
     #   watchdog fires when a device-dispatch span stays open this long,
     #   dumping thread stacks + the in-flight shape group (0 disables)
+    telemetry_port: int = 0             # CLI --telemetry-port: live
+    #   telemetry endpoints (utils/telemetry.py — GET /metrics
+    #   Prometheus text, /healthz ok|degraded, /progress JSON) served
+    #   by a daemon thread for the run's duration.  0 = off (default);
+    #   the port auto-bumps upward when taken, and sharded runs offset
+    #   it per rank (parallel/distributed.py) so every rank is
+    #   scrapeable — `ccsx-tpu top` aggregates them
 
     def metrics_stream(self):
         return open(self.metrics_path, "a") if self.metrics_path else None

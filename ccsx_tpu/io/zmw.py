@@ -85,28 +85,44 @@ def _build(key: tuple, seqs: List[bytes]) -> Zmw:
                lens=lens, offs=offs)
 
 
+def filter_reason(zmw: Zmw, cfg: CcsConfig) -> Optional[str]:
+    """None when the hole passes the read-step filters
+    (main.c:659-672), else the drop-reason bucket — the same reason
+    taxonomy the native streamer reports (ccsx_filter_counts)."""
+    if zmw.n_passes < cfg.min_pass_count:
+        return "few_passes"
+    total = zmw.total_len
+    if total > cfg.max_subread_len:
+        return "too_long"
+    if total < cfg.min_subread_len:
+        return "too_short"
+    if cfg.exclude_holes and zmw.hole in cfg.exclude_holes:
+        return "excluded"
+    return None
+
+
 def zmw_filter(zmw: Zmw, cfg: CcsConfig) -> bool:
     """Keep/drop rule of the pipeline read step (main.c:659-672)."""
-    if zmw.n_passes < cfg.min_pass_count:
-        return False
-    total = zmw.total_len
-    if total > cfg.max_subread_len or total < cfg.min_subread_len:
-        return False
-    if cfg.exclude_holes and zmw.hole in cfg.exclude_holes:
-        return False
-    return True
+    return filter_reason(zmw, cfg) is None
 
 
-def stream_zmws(records: Iterable[FastxRecord], cfg: CcsConfig) -> Iterator[Zmw]:
+def stream_zmws(records: Iterable[FastxRecord], cfg: CcsConfig,
+                metrics=None) -> Iterator[Zmw]:
     for z in group_zmws(records):
-        if zmw_filter(z, cfg):
+        reason = filter_reason(z, cfg)
+        if reason is None:
             yield z
         else:
             # filtered holes are otherwise invisible in a trace: the
-            # driver's ingest spans only see what this generator yields.
-            # Pure-Python ingest path ONLY — the native C++ streamer
+            # driver's ingest spans only see what this generator
+            # yields.  Counted into Metrics (reason-bucketed) when the
+            # driver passes its object; the native C++ streamer
             # (native/io.py) applies the same filters in-library and
-            # emits no per-hole instants (a trace without zmw_filtered
-            # events does NOT mean nothing was filtered)
+            # surfaces its counts at stream EOF instead
+            if metrics is not None:
+                metrics.holes_filtered += 1
+                metrics.filtered_reasons[reason] = (
+                    metrics.filtered_reasons.get(reason, 0) + 1)
             trace.instant("zmw_filtered", cat="ingest", hole=z.hole,
-                          passes=z.n_passes, bases=z.total_len)
+                          passes=z.n_passes, bases=z.total_len,
+                          reason=reason)

@@ -54,6 +54,15 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.ccsx_error.restype = c.c_char_p
     lib.ccsx_error.argtypes = [c.c_void_p]
+    # filter accounting (guarded: a stale prebuilt .so without the
+    # symbols must degrade to "counts unavailable", not fail to load)
+    for name in ("ccsx_filter_counts", "ccsx_prefetch_filter_counts"):
+        try:
+            fn = getattr(lib, name)
+        except AttributeError:
+            continue
+        fn.restype = None
+        fn.argtypes = [c.c_void_p] + [c.POINTER(c.c_int64)] * 3
     lib.ccsx_close.restype = None
     lib.ccsx_close.argtypes = [c.c_void_p]
     for name in ("ccsx_encode", "ccsx_revcomp_ascii", "ccsx_revcomp_codes"):

@@ -18,6 +18,7 @@ from ccsx_tpu.config import CcsConfig
 from ccsx_tpu.io.fastx import FastxRecord
 from ccsx_tpu.io.zmw import InvalidZmwName, Zmw
 from ccsx_tpu import native
+from ccsx_tpu.utils import trace
 
 
 class NativeStreamError(ValueError):
@@ -61,7 +62,8 @@ def read_records_native(path: str, is_bam: bool) -> Iterator[FastxRecord]:
         L.ccsx_close(h)
 
 
-def stream_zmws_native(path: str, cfg: CcsConfig) -> Iterator[Zmw]:
+def stream_zmws_native(path: str, cfg: CcsConfig,
+                       metrics=None) -> Iterator[Zmw]:
     """Filtered ZMW stream through the native group-by-hole streamer.
 
     Opens eagerly — a bad path raises OSError here, not at first next().
@@ -69,10 +71,46 @@ def stream_zmws_native(path: str, cfg: CcsConfig) -> Iterator[Zmw]:
     L, h = _open(path, cfg.is_bam)
     L.ccsx_set_filter(h, cfg.min_pass_count, cfg.min_subread_len,
                       cfg.max_subread_len)
-    return _zmw_gen(h, cfg, L.ccsx_next_zmw, L.ccsx_error, L.ccsx_close)
+    return _zmw_gen(h, cfg, L.ccsx_next_zmw, L.ccsx_error, L.ccsx_close,
+                    counts_fn=getattr(L, "ccsx_filter_counts", None),
+                    metrics=metrics)
 
 
-def _zmw_gen(h, cfg: CcsConfig, next_fn, error_fn, close_fn) -> Iterator[Zmw]:
+def _surface_filter_counts(h, counts_fn, excluded: int, metrics) -> None:
+    """At stream EOF, fold the native reader's in-library filter counts
+    (plus the Python-side -X exclusions) into Metrics — the native path
+    used to report nothing, silently under-reporting filtering in every
+    traced native run (the span-table blind spot ARCHITECTURE.md
+    documents).  A zero-filter stream books nothing."""
+    buckets = {}
+    if counts_fn is not None:
+        few = ctypes.c_int64()
+        short = ctypes.c_int64()
+        long_ = ctypes.c_int64()
+        counts_fn(h, ctypes.byref(few), ctypes.byref(short),
+                  ctypes.byref(long_))
+        buckets = {"few_passes": few.value, "too_short": short.value,
+                   "too_long": long_.value}
+    if excluded:
+        buckets["excluded"] = excluded
+    buckets = {k: v for k, v in buckets.items() if v}
+    if not buckets:
+        return
+    total = sum(buckets.values())
+    if metrics is not None:
+        metrics.holes_filtered += total
+        for k, v in buckets.items():
+            metrics.filtered_reasons[k] = (
+                metrics.filtered_reasons.get(k, 0) + v)
+    # one aggregate instant (the native reader has no per-hole
+    # identity to report), so a trace of a native run still shows that
+    # — and why — holes were dropped
+    trace.instant("zmw_filtered_native", cat="ingest", holes=total,
+                  **buckets)
+
+
+def _zmw_gen(h, cfg: CcsConfig, next_fn, error_fn, close_fn,
+             counts_fn=None, metrics=None) -> Iterator[Zmw]:
     """Shared drain loop for both native streamers (plain and prefetching)."""
     c = ctypes
     movie, hole = c.c_char_p(), c.c_char_p()
@@ -80,12 +118,14 @@ def _zmw_gen(h, cfg: CcsConfig, next_fn, error_fn, close_fn) -> Iterator[Zmw]:
     total = c.c_int64()
     lens = c.POINTER(c.c_int32)()
     n = c.c_int32()
+    excluded = 0
     try:
         while True:
             rc = next_fn(h, c.byref(movie), c.byref(hole),
                          c.byref(seqs), c.byref(total),
                          c.byref(lens), c.byref(n))
             if rc == -1:
+                _surface_filter_counts(h, counts_fn, excluded, metrics)
                 return
             if rc == -2:
                 raise InvalidZmwName(error_fn(h).decode())
@@ -93,6 +133,7 @@ def _zmw_gen(h, cfg: CcsConfig, next_fn, error_fn, close_fn) -> Iterator[Zmw]:
                 raise NativeStreamError(error_fn(h).decode())
             hole_s = hole.value.decode()
             if cfg.exclude_holes and hole_s in cfg.exclude_holes:
+                excluded += 1
                 continue
             lens_np = np.ctypeslib.as_array(lens, shape=(n.value,)).copy()
             offs = np.zeros(n.value, dtype=np.int32)
@@ -107,7 +148,8 @@ def _zmw_gen(h, cfg: CcsConfig, next_fn, error_fn, close_fn) -> Iterator[Zmw]:
 
 
 def stream_zmws_prefetch(path: str, cfg: CcsConfig,
-                         queue_cap: int = 64) -> Iterator[Zmw]:
+                         queue_cap: int = 64,
+                         metrics=None) -> Iterator[Zmw]:
     """Like stream_zmws_native, but parsing/grouping/filtering run on a
     background C++ thread feeding a bounded queue — the native read step of
     the 3-stage pipeline (kt_pipeline step 0, kthread.c:172-256).
@@ -123,7 +165,10 @@ def stream_zmws_prefetch(path: str, cfg: CcsConfig,
     if not h:
         raise OSError(f"cannot open {path!r}")
     return _zmw_gen(h, cfg, L.ccsx_prefetch_next, L.ccsx_prefetch_error,
-                    L.ccsx_prefetch_close)
+                    L.ccsx_prefetch_close,
+                    counts_fn=getattr(L, "ccsx_prefetch_filter_counts",
+                                      None),
+                    metrics=metrics)
 
 
 class NativeFastaWriter:

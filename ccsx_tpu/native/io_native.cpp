@@ -530,6 +530,12 @@ struct Reader {
   int32_t min_passes = 0;
   int64_t min_total = 0, max_total = 0;
 
+  // filter accounting, bucketed by reason (the pure-Python path emits
+  // per-hole zmw_filtered trace instants; the in-library filter here
+  // was a blind spot — ccsx_filter_counts surfaces these so traced
+  // native runs stop silently under-reporting filtering)
+  int64_t filt_few_passes = 0, filt_short = 0, filt_long = 0;
+
   // lookahead carry (seqio.h:158-163)
   Record carry;
   bool have_carry = false;
@@ -601,7 +607,15 @@ struct Reader {
       }
       if (lens.empty()) return -1;
       if (keep()) return (int)lens.size();
-      // filtered: loop to the next hole without crossing the API boundary
+      // filtered: count by reason (same precedence as keep()), then
+      // loop to the next hole without crossing the API boundary
+      if (min_passes > 0 && (int32_t)lens.size() < min_passes) {
+        filt_few_passes++;
+      } else if (max_total > 0 && (int64_t)seqs.size() > max_total) {
+        filt_long++;
+      } else {
+        filt_short++;
+      }
     }
   }
 };
@@ -793,6 +807,17 @@ int ccsx_next_record(void* h, const char** name, const char** comment,
 
 const char* ccsx_error(void* h) { return ((Reader*)h)->error.c_str(); }
 
+// Filter accounting (reason-bucketed counts of holes the in-library
+// filters dropped).  Valid at any point; complete once next_zmw
+// returned EOF.
+void ccsx_filter_counts(void* h, int64_t* few_passes, int64_t* too_short,
+                        int64_t* too_long) {
+  Reader* r = (Reader*)h;
+  *few_passes = r->filt_few_passes;
+  *too_short = r->filt_short;
+  *too_long = r->filt_long;
+}
+
 void ccsx_close(void* h) {
   Reader* r = (Reader*)h;
   GzStream& s = r->is_bam ? r->bam.s : r->fx.s;
@@ -835,6 +860,19 @@ int ccsx_prefetch_next(void* h, const char** movie, const char** hole,
 
 const char* ccsx_prefetch_error(void* h) {
   return ((Prefetcher*)h)->reader.error.c_str();
+}
+
+// Same accounting for the prefetching streamer.  The counters are
+// written by the producer thread; the consumer calls this after EOF
+// (pop() returned rc_final), whose queue-mutex handoff orders the
+// producer's final writes before this read.
+void ccsx_prefetch_filter_counts(void* h, int64_t* few_passes,
+                                 int64_t* too_short, int64_t* too_long) {
+  Prefetcher* p = (Prefetcher*)h;
+  std::lock_guard<std::mutex> lk(p->mu);
+  *few_passes = p->reader.filt_few_passes;
+  *too_short = p->reader.filt_short;
+  *too_long = p->reader.filt_long;
 }
 
 void ccsx_prefetch_close(void* h) {

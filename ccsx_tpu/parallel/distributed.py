@@ -208,15 +208,23 @@ def run_pipeline_sharded(in_path: str, out_path: str, cfg: CcsConfig,
 
     if not (0 <= rank < n):
         raise ValueError(f"rank {rank} outside [0, {n})")
+    import dataclasses
+
     if cfg.trace_path:
         # per-rank flight-recorder files: ranks on one filesystem would
         # otherwise clobber each other's span JSONL.  Metrics streams
         # append and every event carries a wall-clock ts, so THOSE merge
         # on a common timeline; the trace file is opened "w" per run.
-        import dataclasses
-
         cfg = dataclasses.replace(
             cfg, trace_path=f"{cfg.trace_path}.shard{rank}")
+    if cfg.telemetry_port:
+        # per-rank telemetry ports (base + rank): every rank of a
+        # same-host sharded run is scrapeable at a predictable address,
+        # and `ccsx-tpu top host:P host:P+1 ...` aggregates them.  The
+        # server still auto-bumps upward if something else holds the
+        # offset port (drive_batched starts it).
+        cfg = dataclasses.replace(
+            cfg, telemetry_port=cfg.telemetry_port + rank)
     metrics = Metrics(verbose=cfg.verbose, stream=cfg.metrics_stream())
     # byte-range sharded ingest (SURVEY §5.8 "each host reads its own
     # input shard"): a fresh BGZF hole index (ccsx --make-index) lets
@@ -238,15 +246,19 @@ def run_pipeline_sharded(in_path: str, out_path: str, cfg: CcsConfig,
         if idx is not None:
             range_lo, range_hi = bamindex.hole_range(
                 idx["n_holes"], rank, n)
+            # progress/ETA total in RAW holes: this rank owns exactly
+            # its contiguous index range
+            metrics.holes_total = range_hi - range_lo
 
             def _count(nbytes, m=metrics):
                 m.ingest_bytes += nbytes
 
             stream = zmw_mod.stream_zmws(
                 bamindex.read_hole_range(in_path, idx, range_lo,
-                                         range_hi, counter=_count), cfg)
+                                         range_hi, counter=_count), cfg,
+                metrics=metrics)
         else:
-            stream = open_zmw_stream(in_path, cfg)
+            stream = open_zmw_stream(in_path, cfg, metrics=metrics)
             if in_path != "-" and os.path.exists(in_path):
                 # full-parse round-robin: every host ingests the file
                 metrics.ingest_bytes = os.path.getsize(in_path)

@@ -1993,6 +1993,7 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
     # an open trace file can leak, and an unwritable --trace path gets
     # the same polite rc-1 refusal as an unwritable output path
     tracer = None
+    telem = None
     try:
         try:
             tracer = trace.Tracer(cfg.trace_path,
@@ -2003,6 +2004,13 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
                   file=sys.stderr)
             return 1
         trace.install(tracer)
+        # live telemetry endpoints (--telemetry-port; sharded runs
+        # arrive here with the port already rank-offset).  None when
+        # off; a bind failure degrades to a warning, never kills a run
+        if cfg.telemetry_port:
+            from ccsx_tpu.utils import telemetry
+
+            telem = telemetry.start(metrics, cfg.telemetry_port)
         while True:
             # admit up to the in-flight window; bound TOTAL outstanding
             # holes (incl. instantly-finished ones parked for ordered
@@ -2073,6 +2081,9 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
                     still.append(h)
             active = still
             emit_ready()
+            # interval-driven progress events even while nothing has
+            # retired yet (a holes<=inflight run drains at the very end)
+            metrics.heartbeat()
     except (bam_mod.BamError, zmw_mod.InvalidZmwName, ValueError) as e:
         print(f"Error: invalid input stream: {e}", file=sys.stderr)
         rc = 1
@@ -2098,6 +2109,10 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
         trace.uninstall()
         if tracer is not None:
             tracer.close()
+        # endpoints down BEFORE the final event: a scraper must never
+        # see a half-closed Metrics object
+        if telem is not None:
+            telem.close()
         metrics.report()
     return rc
 
@@ -2125,19 +2140,26 @@ def run_pipeline_batched(in_path: str, out_path: str, cfg: CcsConfig,
                          journal_path: Optional[str] = None,
                          inflight: Optional[int] = None) -> int:
     """Batched end-to-end driver (CLI --batch; default on TPU backends)."""
-    from ccsx_tpu.pipeline.run import open_writer, open_zmw_stream
+    from ccsx_tpu.pipeline.run import (holes_total_hint, open_writer,
+                                       open_zmw_stream)
     from ccsx_tpu.utils.device import resolve_device
 
+    # metrics constructed before the stream so both ingest paths can
+    # book their filtered-hole accounting into it
+    metrics = Metrics(verbose=cfg.verbose, stream=cfg.metrics_stream())
+    metrics.holes_total = holes_total_hint(in_path, cfg)
     try:
-        stream = open_zmw_stream(in_path, cfg)
+        stream = open_zmw_stream(in_path, cfg, metrics=metrics)
     except (OSError, RuntimeError) as e:
         print(f"Error: Failed to open infile! ({e})", file=sys.stderr)
+        metrics.close_stream()  # no final event for a non-run
         return 1
 
     # resolve the backend and validate the mesh BEFORE the writer opens:
     # a bad --mesh must not truncate an existing output file
     resolve_device(cfg.device)
     if mesh_precheck(cfg):
+        metrics.close_stream()
         return 1
 
     # load under this run's fingerprint + reconcile the output tail with
@@ -2150,7 +2172,7 @@ def run_pipeline_batched(in_path: str, out_path: str, cfg: CcsConfig,
                              journaled=bool(journal_path))
     except OSError as e:
         print(f"Cannot open file for write! ({e})", file=sys.stderr)
+        metrics.close_stream()
         return 1
-    metrics = Metrics(verbose=cfg.verbose, stream=cfg.metrics_stream())
     return drive_batched(stream, writer, cfg, journal, metrics,
                          inflight or cfg.zmw_microbatch)

@@ -27,25 +27,43 @@ from ccsx_tpu.utils.journal import Journal
 from ccsx_tpu.utils.metrics import Metrics
 
 
-def open_zmw_stream(path: str, cfg: CcsConfig):
+def open_zmw_stream(path: str, cfg: CcsConfig, metrics=None):
     """Filtered ZMW iterator for BAM or FASTA/Q input ('-' = stdin).
 
     Uses the native C++ streamer (parser + group-by-hole + filters in one
     pass, ccsx_tpu/native) when the library is available and the input is a
     real path; otherwise the pure-Python parsers.  Opens the file eagerly —
     the parsers are generators, and a deferred open() would crash past the
-    caller's error handling.
+    caller's error handling.  ``metrics`` (optional) receives the
+    filtered-hole accounting from either path: per-hole live on the
+    Python path, reason-bucketed at EOF from the native reader.
     """
     from ccsx_tpu import native
 
     if path != "-" and native.available():
         from ccsx_tpu.native.io import stream_zmws_prefetch
 
-        return stream_zmws_prefetch(path, cfg)
+        return stream_zmws_prefetch(path, cfg, metrics=metrics)
     f = sys.stdin.buffer if path == "-" else open(path, "rb")
     records = (bam_mod.read_bam_records(f) if cfg.is_bam
                else fastx.read_fastx(f))
-    return zmw.stream_zmws(records, cfg)
+    return zmw.stream_zmws(records, cfg, metrics=metrics)
+
+
+def holes_total_hint(in_path: str, cfg: CcsConfig):
+    """RAW hole count of the input when cheaply knowable (the BGZF hole
+    index sidecar, `ccsx-tpu --make-index`), else None — feeds the
+    progress/ETA estimator's total (Metrics.holes_total).  Raw holes:
+    filtered holes count toward progress `done`, so the basis matches."""
+    if not cfg.is_bam or in_path == "-" or not os.path.exists(in_path):
+        return None
+    try:
+        from ccsx_tpu.io import bamindex
+
+        idx = bamindex.load_index(in_path)
+    except (OSError, ValueError):
+        return None
+    return idx["n_holes"] if idx else None
 
 
 class _PyWriter:
@@ -110,10 +128,15 @@ def open_writer(path: str, append: bool, bam: bool = False,
 
 def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
                  journal_path: Optional[str] = None) -> int:
+    # metrics constructed before the stream so both ingest paths can
+    # book their filtered-hole accounting into it
+    metrics = Metrics(verbose=cfg.verbose, stream=cfg.metrics_stream())
+    metrics.holes_total = holes_total_hint(in_path, cfg)
     try:
-        stream = open_zmw_stream(in_path, cfg)
+        stream = open_zmw_stream(in_path, cfg, metrics=metrics)
     except (OSError, RuntimeError) as e:
         print(f"Error: Failed to open infile! ({e})", file=sys.stderr)
+        metrics.close_stream()  # no final event for a non-run
         return 1
     # load under this run's fingerprint + reconcile the output tail
     # (truncate torn / refuse untrustworthy) before the writer opens
@@ -125,11 +148,11 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
                              journaled=bool(journal_path))
     except OSError as e:
         print(f"Cannot open file for write! ({e})", file=sys.stderr)
+        metrics.close_stream()
         return 1
 
     resolve_device(cfg.device)
     aligner = HostAligner(cfg.align)
-    metrics = Metrics(verbose=cfg.verbose, stream=cfg.metrics_stream())
 
     def compute(z):
         stats: dict = {}
@@ -180,6 +203,7 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
     # an open trace file can leak, and an unwritable --trace path gets
     # the same polite rc-1 refusal as an unwritable output path
     tracer = None
+    telem = None
     try:
         try:
             tracer = trace.Tracer(cfg.trace_path,
@@ -190,6 +214,12 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
                   file=sys.stderr)
             return 1
         trace.install(tracer)
+        # live telemetry endpoints (--telemetry-port; None when off —
+        # a bind failure degrades to a warning, never kills the run)
+        if cfg.telemetry_port:
+            from ccsx_tpu.utils import telemetry
+
+            telem = telemetry.start(metrics, cfg.telemetry_port)
         while True:
             try:
                 with metrics.timer("ingest"), \
@@ -201,6 +231,7 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
             metrics.holes_in += 1
             if metrics.holes_in <= resume:
                 continue  # already written in a previous run
+            metrics.heartbeat()
             if pool is None:
                 with metrics.timer("compute"):
                     item = compute(z)
@@ -236,5 +267,9 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
         trace.uninstall()
         if tracer is not None:
             tracer.close()
+        # endpoints down BEFORE the final event: a scraper must never
+        # see a half-closed Metrics object
+        if telem is not None:
+            telem.close()
         metrics.report()
     return rc

@@ -7,6 +7,7 @@ north-star metric, BASELINE.md), and optional JSON-lines emission.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import dataclasses
 import json
@@ -16,6 +17,40 @@ import time
 from typing import Optional, TextIO
 
 
+def resource_gauges() -> dict:
+    """Peak host RSS + per-device live-buffer bytes, best effort (0 when
+    unknown) — the OOM-ladder postmortems previously had no memory
+    signal at all.  Stamped on the metrics "final" event and served
+    live by /metrics (utils/telemetry.py).  Never *imports* jax: a
+    process that avoided backend init (stats/top/report subcommands on
+    a host whose accelerator is hung) must stay backend-free."""
+    peak = 0
+    try:
+        import resource
+
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # ru_maxrss is KiB on Linux, bytes on macOS
+        if sys.platform != "darwin":
+            peak *= 1024
+    except (ImportError, OSError, ValueError):
+        peak = 0
+    dev = 0
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            for d in jax.local_devices():
+                stats = getattr(d, "memory_stats", lambda: None)()
+                if stats:
+                    dev += int(stats.get("bytes_in_use", 0))
+            if dev == 0:
+                # backends without allocator stats (XLA:CPU): fall back
+                # to the live-array census
+                dev = sum(int(a.nbytes) for a in jax.live_arrays())
+        except Exception:
+            dev = 0
+    return {"peak_rss_bytes": int(peak), "device_buffer_bytes": int(dev)}
+
+
 @dataclasses.dataclass
 class Metrics:
     verbose: int = 0
@@ -23,6 +58,14 @@ class Metrics:
     holes_in: int = 0
     holes_out: int = 0
     holes_failed: int = 0
+    # holes dropped by the ingest filters (main.c:659-672 semantics),
+    # with per-reason buckets (few_passes / too_short / too_long /
+    # excluded).  Fed by BOTH ingest paths: io/zmw.stream_zmws counts
+    # live, and the native C++ streamer — which filters in-library and
+    # used to report nothing — surfaces its counts at stream EOF
+    # (native/io.py, ccsx_filter_counts)
+    holes_filtered: int = 0
+    filtered_reasons: dict = dataclasses.field(default_factory=dict)
     windows: int = 0
     pair_alignments: int = 0   # batched prep strand_match pairs
     device_dispatches: int = 0
@@ -99,8 +142,27 @@ class Metrics:
     t_compute: float = 0.0
     t_write: float = 0.0
     # a "progress" JSONL event is emitted every progress_every retired
-    # holes (0 disables); "final" is always emitted at report()
+    # holes (0 disables); "final" is always emitted at report().  The
+    # live-telemetry plane also emits one every progress_interval_s
+    # seconds of wall (0 disables) so slow runs still produce a usable
+    # ETA-vs-actual series (`ccsx-tpu report`) and a tailable stream
+    # (`ccsx-tpu top` on endpoint-less runs)
     progress_every: int = 512
+    progress_interval_s: float = 30.0
+    # progress/ETA estimator: total holes this run will retire when
+    # knowable (the BGZF hole index sidecar / a rank's hole range —
+    # RAW holes, so filtered holes count toward done), else None =
+    # unknown-total mode (rate only, no pct/ETA)
+    holes_total: Optional[int] = None
+    # windowed-rate ring buffer of (monotonic, holes retired): the
+    # instantaneous zmws/sec over the last <= _RATE_WINDOW samples
+    # (sampled at >= _RATE_SAMPLE_S spacing), robust to the cold-start
+    # compile minutes that make the whole-run average useless for ETA
+    _rate_ring: collections.deque = dataclasses.field(
+        default_factory=lambda: collections.deque(maxlen=128),
+        repr=False)
+    _last_interval_emit: float = dataclasses.field(
+        default_factory=time.monotonic, repr=False)
     # per-shape-group dispatch attribution (utils/trace.py fills this:
     # compiles, compile_s, execute_s, dispatches, dp_cells per group
     # key) — rendered into every event by snapshot() so recompile
@@ -108,8 +170,11 @@ class Metrics:
     group_stats: dict = dataclasses.field(default_factory=dict)
     # set by the stall watchdog (utils/trace.py) when a device dispatch
     # hangs past --stall-timeout: the run completed (or died) degraded,
-    # and every later event — including "final" — says so
+    # and every later event — including "final" — says so.  stalls
+    # counts the watchdog's reports (full + compact) — the /healthz
+    # detail an operator triages by
     degraded: Optional[str] = None
+    stalls: int = 0
     # set by the Tracer: True when device spans used the forced-
     # execution close (--trace), i.e. the group table's seconds are
     # real chip walls; False means dispatch-queue bookkeeping on an
@@ -132,14 +197,51 @@ class Metrics:
             setattr(self, attr, getattr(self, attr)
                     + time.perf_counter() - t0)
 
+    # windowed-rate sampling: coalesce ring samples closer than this
+    # (a fast run must not shrink the window to microseconds), and keep
+    # at most _rate_ring.maxlen of them (~32 s+ of history)
+    _RATE_SAMPLE_S = 0.25
+
     def tick(self) -> None:
-        """Called once per retired hole; emits periodic progress events."""
+        """Called once per retired hole; feeds the windowed-rate ring
+        and emits periodic progress events (every progress_every holes
+        AND every progress_interval_s seconds of wall)."""
         self._ticked += 1
-        if self.progress_every and self._ticked % self.progress_every == 0:
+        now = time.monotonic()
+        ring = self._rate_ring
+        if not ring or now - ring[-1][0] >= self._RATE_SAMPLE_S:
+            # sample RETIRED holes (+ filtered, which retire at zero
+            # cost) — the same basis progress_snapshot reports.
+            # Ingested-but-in-flight holes must NOT count: the batched
+            # scheduler admits a whole inflight window up front, which
+            # would read as instant-100% progress on small runs
+            ring.append((now, self._ticked + self.holes_filtered))
+        due = (self.progress_every
+               and self._ticked % self.progress_every == 0)
+        if (self.progress_interval_s
+                and now - self._last_interval_emit
+                >= self.progress_interval_s):
+            due = True
+        if due:
+            self._last_interval_emit = now
             self.emit("progress")
             if self.verbose:
                 print(f"[ccsx-tpu] progress {json.dumps(self.snapshot())}",
                       file=sys.stderr)
+
+    def heartbeat(self) -> None:
+        """Called from the driver loops between retirements: emits the
+        interval-driven progress event even when no hole has retired
+        for a while — a single-admission-batch run (holes <= inflight)
+        retires everything in its final drain, and tick()-only emission
+        would leave the metrics stream silent for the whole middle of
+        the run."""
+        if not self.progress_interval_s:
+            return
+        now = time.monotonic()
+        if now - self._last_interval_emit >= self.progress_interval_s:
+            self._last_interval_emit = now
+            self.emit("progress")
 
     @property
     def elapsed(self) -> float:
@@ -148,6 +250,36 @@ class Metrics:
     @property
     def zmws_per_sec(self) -> float:
         return self.holes_out / self.elapsed
+
+    def progress_snapshot(self) -> dict:
+        """The streaming progress/ETA estimate: retired-hole count,
+        windowed rate, and — when holes_total is knowable — percent
+        done and ETA seconds.  Unknown-total mode reports rate only.
+        Rides every metrics event (snapshot()) and the /progress +
+        /metrics endpoints (utils/telemetry.py)."""
+        # retired holes + filtered holes (retired at zero cost).  NOT
+        # holes_in: in-flight admissions are unfinished work.  Resumed
+        # holes skip tick(), so a resumed run's pct undercounts by the
+        # prior run's share — conservative, never optimistic
+        done = self._ticked + self.holes_filtered
+        ring = list(self._rate_ring)
+        if len(ring) >= 2 and ring[-1][0] > ring[0][0]:
+            rate = (ring[-1][1] - ring[0][1]) / (ring[-1][0] - ring[0][0])
+        else:
+            rate = done / self.elapsed
+        prog = {
+            "done": done,
+            "total": self.holes_total,
+            "rate_zmws_per_sec": round(rate, 3),
+            "elapsed_s": round(self.elapsed, 3),
+        }
+        if self.holes_total:
+            prog["pct"] = round(min(done / self.holes_total, 1.0) * 100,
+                                2)
+            remaining = max(self.holes_total - done, 0)
+            prog["eta_s"] = (round(remaining / rate, 1) if rate > 0
+                             else None)
+        return prog
 
     def _group_table(self) -> dict:
         """Render group_stats for events, via the one shared finalizer
@@ -164,6 +296,8 @@ class Metrics:
             "holes_in": self.holes_in,
             "holes_out": self.holes_out,
             "holes_failed": self.holes_failed,
+            "holes_filtered": self.holes_filtered,
+            "stalls": self.stalls,
             "windows": self.windows,
             "pair_alignments": self.pair_alignments,
             "device_dispatches": self.device_dispatches,
@@ -208,7 +342,12 @@ class Metrics:
             "write_s": round(self.t_write, 6),
             "elapsed_s": round(self.elapsed, 3),
             "zmws_per_sec": round(self.zmws_per_sec, 3),
+            "progress": self.progress_snapshot(),
         }
+        if self.filtered_reasons:
+            # dict() copy: the telemetry thread snapshots while the
+            # ingest loop may be inserting a new reason bucket
+            snap["filtered_reasons"] = dict(self.filtered_reasons)
         if self.group_stats:
             snap["groups"] = self._group_table()
             snap["groups_forced"] = bool(self.groups_forced)
@@ -237,10 +376,27 @@ class Metrics:
                 self.stream.write(json.dumps(rec) + "\n")
                 self.stream.flush()
 
+    def close_stream(self) -> None:
+        """Close the metrics stream WITHOUT emitting a final event —
+        the drivers' early-exit error paths (stream/writer open
+        failed): a run that never started must not leave a 'final'
+        record, but must not leak the open file either."""
+        if self.stream is not None and self.stream not in (sys.stdout,
+                                                           sys.stderr):
+            with self._emit_lock:
+                try:
+                    self.stream.close()
+                except OSError:
+                    pass
+                self.stream = None
+
     def report(self) -> None:
         if self.verbose:
             print(f"[ccsx-tpu] {json.dumps(self.snapshot())}", file=sys.stderr)
-        self.emit("final")
+        # final carries the resource gauges (peak RSS, device buffers):
+        # sampled once at close rather than in snapshot() — the
+        # live-array census is not cheap enough for every event
+        self.emit("final", **resource_gauges())
         if self.stream is not None and self.stream not in (sys.stdout,
                                                            sys.stderr):
             with self._emit_lock:

@@ -79,6 +79,14 @@ from typing import Dict, Optional
 CATEGORIES = ("ingest", "prep", "compute", "device", "recover", "write",
               "journal", "host")
 
+# metrics-snapshot keys the stats occupancy recap consumes — a module
+# constant so the telemetry schema-drift guard (tests/test_telemetry.py)
+# can prove a Metrics rename cannot silently zero a stats column
+OCCUPANCY_KEYS = ("dp_occupancy", "dp_round_occupancy", "dp_length_fill",
+                  "dp_pass_fill", "dp_z_fill", "dp_row_fill",
+                  "packed_holes_per_dispatch", "zmws_per_sec",
+                  "device_dispatches", "holes_out", "elapsed_s")
+
 _current: Optional["Tracer"] = None
 
 # the stall watchdog multiplies its timeout by this for the FIRST
@@ -87,6 +95,14 @@ _current: Optional["Tracer"] = None
 # comment), and a healthy cold run must not be stamped degraded.
 # Steady-state spans get the bare --stall-timeout.
 COMPILE_GRACE = 10.0
+
+# stall-report rate limit: the FIRST report is the full dump (all
+# thread stacks + plan + metrics snapshot, can be megabytes with many
+# threads); later reports within this window are compact one-liners —
+# a long genuine hang stalls span after span, and without the limit it
+# floods stderr/trace/metrics with identical stacks.  After the window
+# a fresh full dump is allowed (a second, later hang deserves stacks).
+FULL_DUMP_EVERY_S = 600.0
 
 
 def install(tracer: "Tracer") -> None:
@@ -179,6 +195,8 @@ class Tracer:
             # consumer must be able to tell that from forced evidence
             metrics.groups_forced = self.forced
         self.stalled = False
+        self._stall_dumps = 0      # reports so far (rate-limit state)
+        self._last_full_dump = -float("inf")
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
         self._t0_wall = time.time()
@@ -420,38 +438,61 @@ class Tracer:
                 self._stall_dump(s, now - s.t0)
 
     def _stall_dump(self, sp: Span, age: float) -> None:
-        """The actionable hang report: all thread stacks, the in-flight
-        shape group/plan, and a metrics snapshot — stderr + trace file +
-        metrics stream, then the run is marked degraded."""
+        """The actionable hang report — stderr + trace file + metrics
+        stream, then the run is marked degraded.  Rate-limited: the
+        first report is the FULL dump (all thread stacks, the in-flight
+        shape group/plan, a metrics snapshot); reports within
+        FULL_DUMP_EVERY_S of the last full dump are compact one-liners
+        (a long genuine hang stalls span after span, and megabytes of
+        identical stacks help nobody)."""
         self.stalled = True
-        names = {t.ident: t.name for t in threading.enumerate()}
-        stacks = {}
-        for tid, frame in sys._current_frames().items():
-            label = f"{names.get(tid, '?')}({tid})"
-            stacks[label] = "".join(traceback.format_stack(frame))
-        snap = self.metrics.snapshot() if self.metrics is not None else {}
-        out = [
-            f"[ccsx-tpu] STALL WATCHDOG: device dispatch {sp.name!r} "
-            f"group={sp.args.get('group')!r} open for {age:.1f}s "
-            f"(> {self.stall_timeout * sp.grace:g}s stall budget"
-            + (f" = {sp.grace:g}x compile grace" if sp.grace > 1 else "")
-            + ") — dumping state",
-            f"[ccsx-tpu]   in-flight: args={json.dumps(sp.args, default=str)}",
-        ]
-        for label, stack in stacks.items():
-            out.append(f"[ccsx-tpu]   -- thread {label} --")
-            out.append(stack.rstrip("\n"))
-        out.append(f"[ccsx-tpu]   metrics: "
-                   f"{json.dumps(snap, default=str)}")
-        print("\n".join(out), file=sys.stderr)
+        now = time.perf_counter()
+        full = now - self._last_full_dump >= FULL_DUMP_EVERY_S
+        self._stall_dumps += 1
+        if self.metrics is not None:
+            self.metrics.stalls += 1
+        if full:
+            self._last_full_dump = now
+            names = {t.ident: t.name for t in threading.enumerate()}
+            stacks = {}
+            for tid, frame in sys._current_frames().items():
+                label = f"{names.get(tid, '?')}({tid})"
+                stacks[label] = "".join(traceback.format_stack(frame))
+            snap = (self.metrics.snapshot()
+                    if self.metrics is not None else {})
+            out = [
+                f"[ccsx-tpu] STALL WATCHDOG: device dispatch {sp.name!r} "
+                f"group={sp.args.get('group')!r} open for {age:.1f}s "
+                f"(> {self.stall_timeout * sp.grace:g}s stall budget"
+                + (f" = {sp.grace:g}x compile grace"
+                   if sp.grace > 1 else "")
+                + ") — dumping state",
+                f"[ccsx-tpu]   in-flight: "
+                f"args={json.dumps(sp.args, default=str)}",
+            ]
+            for label, stack in stacks.items():
+                out.append(f"[ccsx-tpu]   -- thread {label} --")
+                out.append(stack.rstrip("\n"))
+            out.append(f"[ccsx-tpu]   metrics: "
+                       f"{json.dumps(snap, default=str)}")
+            print("\n".join(out), file=sys.stderr)
+        else:
+            print(f"[ccsx-tpu] STALL WATCHDOG: dispatch {sp.name!r} "
+                  f"group={sp.args.get('group')!r} open {age:.1f}s "
+                  f"(report #{self._stall_dumps}; full dump above, "
+                  "compact repeat)", file=sys.stderr)
         sys.stderr.flush()
-        self._write({"ev": "stall", "name": sp.name,
-                     "group": sp.args.get("group"),
-                     "open_s": round(age, 3),
-                     "ts": round(time.time(), 6),
-                     "mono": round(time.perf_counter() - self._t0, 6),
-                     "tid": sp.tid, "args": sp.args,
-                     "stacks": {k: v[-4000:] for k, v in stacks.items()}})
+        rec = {"ev": "stall", "name": sp.name,
+               "group": sp.args.get("group"),
+               "open_s": round(age, 3),
+               "ts": round(time.time(), 6),
+               "mono": round(time.perf_counter() - self._t0, 6),
+               "tid": sp.tid, "args": sp.args}
+        if full:
+            rec["stacks"] = {k: v[-4000:] for k, v in stacks.items()}
+        else:
+            rec["repeat"] = self._stall_dumps
+        self._write(rec)
         if self.metrics is not None:
             self.metrics.degraded = (
                 f"stall watchdog fired: dispatch {sp.name} "
@@ -459,7 +500,9 @@ class Tracer:
                 f"{self.stall_timeout * sp.grace:g}s")
             self.metrics.emit("stall", span=sp.name,
                               group=sp.args.get("group"),
-                              open_s=round(age, 3))
+                              open_s=round(age, 3),
+                              **({} if full
+                                 else {"repeat": self._stall_dumps}))
 
     # ---- lifecycle -------------------------------------------------------
 
@@ -702,10 +745,7 @@ def summarize(paths, top: int = 10) -> dict:
     mrec = final or last_metrics
     occupancy = {}
     if mrec:
-        for k in ("dp_occupancy", "dp_round_occupancy", "dp_length_fill",
-                  "dp_pass_fill", "dp_z_fill", "dp_row_fill",
-                  "packed_holes_per_dispatch", "zmws_per_sec",
-                  "device_dispatches", "holes_out", "elapsed_s"):
+        for k in OCCUPANCY_KEYS:
             if mrec.get(k) is not None:
                 occupancy[k] = mrec[k]
     slowest = [e for _, _, e in
