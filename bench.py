@@ -123,6 +123,28 @@ def compare_with_prev(line, prev, artifact):
                         f"{prev_c}->{cur_c} (compile storm)")
         if compiles_cmp:
             vp["group_compiles_max"] = compiles_cmp
+        # prep-share gate (ISSUE 8): the prep plane keeps host prep off
+        # the critical path, so a config whose blocked-prep share climbs
+        # back above the acceptance ceiling AND clearly above the prior
+        # artifact's is a regression of the overlap itself.  The 0.10
+        # floor keeps small-number noise (tiny e2e configs, ~seconds of
+        # wall) from tripping it; prior artifacts without the counter
+        # simply don't compare.
+        prep_cmp = {}
+        for e in line.get("e2e", []):
+            pe = prev_e2e.get(e.get("config"))
+            cur_p = (e or {}).get("prep_share")
+            prev_p = (pe or {}).get("prep_share") if pe else None
+            if cur_p is None or prev_p is None:
+                continue
+            prep_cmp[str(e["config"])] = {"prev": prev_p, "cur": cur_p}
+            if cur_p > 0.10 and cur_p > prev_p * 1.5:
+                regressed.append(
+                    f"e2e c{e['config']} prep_share "
+                    f"{prev_p}->{cur_p} (prep back on the critical "
+                    "path)")
+        if prep_cmp:
+            vp["prep_share"] = prep_cmp
         for e in line.get("e2e", []):
             pe = prev_e2e.get(e.get("config"))
             if (not pe or not pe.get("zmws_per_sec")
@@ -469,7 +491,8 @@ def _inner_main():
                 results.append({k: r.get(k) for k in (
                     "config", "backend", "holes_in", "holes_out",
                     "zmws_per_sec", "dp_row_fill",
-                    "packed_holes_per_dispatch", "groups", "degraded",
+                    "packed_holes_per_dispatch", "prep_share",
+                    "prep_overlap_share", "groups", "degraded",
                     "traced", "mean_identity")})
             except Exception as exc:  # keep the primary metric alive
                 results.append({"config": cfg, "error": repr(exc)[:200]})

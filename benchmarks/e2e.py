@@ -121,6 +121,11 @@ def run_config(config: int, n_holes: int, batch: str, seed: int = 0,
             "holes_out": len(got),
             "seconds": round(dt, 3),
             "zmws_per_sec": round(len(got) / dt, 3),
+            # prep plane (pipeline/prep_pool.py): critical-path prep
+            # share of wall + how much prep work the overlap hid
+            # (bench.py's vs_prev gates prep_share regressions)
+            "prep_share": final.get("prep_share"),
+            "prep_overlap_share": final.get("prep_overlap_share"),
             # ragged pass-packing occupancy (batched runs; None under
             # --batch off or the bucketed control)
             "dp_row_fill": final.get("dp_row_fill"),

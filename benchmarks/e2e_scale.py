@@ -198,6 +198,16 @@ def run_scale(n_holes: int, inflight: int, rng, device: str = "auto",
                 final["device_dispatches"] / max(len(got), 1), 2),
             "refine_overflows": final["refine_overflows"],
             "pair_alignments": final["pair_alignments"],
+            # prep plane (pipeline/prep_pool.py): the acceptance
+            # counter prep_share = driver-blocked prep / wall (<= 0.10
+            # bar, ISSUE 8), overlap quality, and the pool gauges.
+            # prep_s remains the prep WORK seconds (summed across pool
+            # threads when the pool is on)
+            "prep_share": final.get("prep_share"),
+            "prep_overlap_share": final.get("prep_overlap_share"),
+            "prep_blocked_s": final.get("prep_blocked_s"),
+            "prep_threads": final.get("prep_threads"),
+            "prep_queue_peak": final.get("prep_queue_peak"),
             # padding accounting (SURVEY §7.3 item 2): the fraction of
             # dispatched DP fill cells that belong to real pass-rows at
             # true qlen — what pass/length/Z bucket tuning controls
@@ -266,6 +276,11 @@ def main():
     ap.add_argument("--no-warmup", action="store_true", dest="no_warmup",
                     help="forwarded to the CLI: disable the AOT warmup "
                          "precompiler (the warmup-on/off A/B arm)")
+    ap.add_argument("--prep-threads", type=int, default=None,
+                    dest="prep_threads",
+                    help="forwarded to the CLI: overlapped prep plane "
+                         "width (0 = inline prep, the A/B control) "
+                         "[CLI auto]")
     ap.add_argument("--trace", default=None,
                     help="forwarded to the CLI: dispatch flight "
                          "recorder span JSONL (+ Chrome export); the "
@@ -304,6 +319,9 @@ def main():
     if a.no_warmup:
         extra = extra + ("--no-warmup",)
         res["warmup"] = False
+    if a.prep_threads is not None:
+        extra = extra + ("--prep-threads", str(a.prep_threads))
+        res["prep_threads"] = a.prep_threads
     if a.stall_timeout is not None:
         extra = extra + ("--stall-timeout", str(a.stall_timeout))
         res["stall_timeout"] = a.stall_timeout

@@ -51,6 +51,11 @@ def main():
     ap.add_argument("--json", default=None)
     ap.add_argument("--device", default="auto",
                     choices=["auto", "tpu", "cpu"])
+    ap.add_argument("--prep-threads", type=int, default=None,
+                    dest="prep_threads",
+                    help="forwarded to the CLI: overlapped prep plane "
+                         "width (0 = inline, the A/B control) "
+                         "[CLI auto]")
     ap.add_argument("--bench-zmw-windows-per-sec", type=float, default=None,
                     help="round speed for the at-peak projection "
                          "[read BENCH value or bench_peak.json]")
@@ -68,9 +73,11 @@ def main():
         open(fa, "w").write(synth.make_fasta(zs))
         out = os.path.join(tmp, "out.fa")
         met = os.path.join(tmp, "m.jsonl")
+        extra = ([] if a.prep_threads is None
+                 else ["--prep-threads", str(a.prep_threads)])
         t0 = time.perf_counter()
         rc = cli.main(["-A", "-m", "1000", "--batch", "on",
-                       "--metrics", met, fa, out])
+                       "--metrics", met, *extra, fa, out])
         wall = time.perf_counter() - t0
         assert rc == 0
         final = [json.loads(line) for line in open(met)][-1]
@@ -89,7 +96,16 @@ def main():
         "windows": windows,
         "device_dispatches": final["device_dispatches"],
         "prep_ms_per_hole": round(prep_s / a.holes * 1e3, 3),
+        # prep WORK share (summed across pool threads when the prep
+        # plane is on — can legitimately exceed the blocked share)
         "prep_share_measured": round(prep_s / max(wall, 1e-9), 4),
+        # prep plane counters (pipeline/prep_pool.py): the critical-path
+        # share the <= 0.10 bar reads, and the overlap quality
+        "prep_threads": final.get("prep_threads"),
+        "prep_blocked_s": final.get("prep_blocked_s"),
+        "prep_share_blocked": final.get("prep_share"),
+        "prep_overlap_share": final.get("prep_overlap_share"),
+        "prep_queue_peak": final.get("prep_queue_peak"),
     }
     # at-peak projection: what the share becomes when the device rounds
     # run at bench.py speed (each zmw-window ~ 1/bench_rate seconds).

@@ -68,6 +68,12 @@ TPU extensions (long options):
 --no-warmup               (disable the AOT warmup precompiler: cold
                            compiles then stall the first dispatch of
                            each shape instead of overlapping ingest)
+--prep-threads <int>      (overlapped prep plane: background threads
+                           ingest + run the orientation walk ahead of
+                           the admission window so host prep overlaps
+                           device compute; 0 = inline prep on the
+                           driver thread, the old behavior; output
+                           bytes identical either way) [auto]
 --pass-buckets a,b,...    (bucketed-grouping A/B control: disables pass
                            packing and pads passes to these buckets)
 --inject-faults p@N,...   (deterministic fault injection; testing only)
@@ -154,6 +160,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "(pipeline/warmup.py): compiles then block the "
                         "first dispatch of each shape instead of "
                         "overlapping ingest/prep")
+    p.add_argument("--prep-threads", type=int, default=None,
+                   dest="prep_threads", metavar="N",
+                   help="overlapped prep plane (pipeline/prep_pool.py): "
+                        "N background threads ingest + run the "
+                        "orientation walk ahead of the admission "
+                        "window, overlapping host prep with device "
+                        "compute; 0 = inline prep (the old behavior). "
+                        "Output bytes are identical either way "
+                        "[auto-size to the host]")
     p.add_argument("--fastq", action="store_true", dest="fastq",
                    help="Write FASTQ with per-base vote-margin qualities "
                         "instead of FASTA (extension; the reference "
@@ -171,8 +186,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Batched device pipeline: many holes per TPU "
                         "dispatch [auto: on for TPU backends]")
     p.add_argument("--inflight", type=int, default=None,
-                   help="Holes in flight in the batched pipeline "
-                        "[zmw_microbatch]")
+                   help="Pin the batched pipeline's admission window "
+                        "to exactly N holes.  Default (or <= 0): the "
+                        "adaptive window — starts at zmw_microbatch/16 "
+                        "and grows x4 per filled round up to "
+                        "zmw_microbatch (the reference's chunk policy, "
+                        "main.c:686-691)")
     p.add_argument("--journal", default=None,
                    help="Progress journal path for resumable runs")
     p.add_argument("--metrics", default=None,
@@ -293,6 +312,11 @@ def config_from_args(args) -> CcsConfig:
         print(f"Error: --telemetry-port must be in [0, 65535], got "
               f"{telemetry_port}", file=sys.stderr)
         raise SystemExit(1)
+    prep_threads = getattr(args, "prep_threads", None)
+    if prep_threads is not None and not 0 <= prep_threads <= 64:
+        print(f"Error: --prep-threads must be in [0, 64], got "
+              f"{prep_threads}", file=sys.stderr)
+        raise SystemExit(1)
     return CcsConfig(
         min_subread_len=args.min_len,
         max_subread_len=args.max_len,
@@ -317,6 +341,7 @@ def config_from_args(args) -> CcsConfig:
         # path; the default is ragged pass packing (pipeline/pack.py)
         pass_packing=pass_buckets is None,
         warmup_compile=not getattr(args, "no_warmup", False),
+        prep_threads=prep_threads,
         **({"pass_buckets": pass_buckets} if pass_buckets else {}),
         **({"slab_rows": slab_rows} if slab_rows else {}),
         **({"slab_shape_ladder": slab_ladder}

@@ -148,7 +148,18 @@ class CcsConfig:
     #   canonical executables as soon as prep predicts them, overlapping
     #   cold compiles with ingest instead of stalling the first dispatch
     #   of every shape.  CLI --no-warmup disables
-    zmw_microbatch: int = 64           # ZMWs per device dispatch
+    zmw_microbatch: int = 64           # ZMWs per device dispatch; also the
+    #   ADAPTIVE admission-window cap of the batched driver: without an
+    #   explicit --inflight the window starts at cap/chunk_growth^2 and
+    #   multiplies by chunk_growth per filled admission round — the
+    #   reference's 1024 -> x4 -> 16384 policy (main.c:686-691) scaled
+    prep_threads: Optional[int] = None  # overlapped prep plane (pipeline/
+    #   prep_pool.py): background threads that ingest + run the
+    #   orientation walk ahead of the admission window, feeding the
+    #   batched driver through a ready queue so host prep overlaps
+    #   device compute instead of adding to it.  None = auto-size to
+    #   the host; 0 = the old inline behavior (CLI --prep-threads).
+    #   Output bytes are identical either way
     len_bucket_quant: int = 512        # whole-read mode: lengths padded to multiple
 
     # ---- device/mesh ----

@@ -120,6 +120,13 @@ class PairRequest:
     q: np.ndarray
     t: np.ndarray
     pct: int
+    # Optional identity token for ``t``: requests carrying the same token
+    # share one template array, so the executor's seeding can sort its
+    # k-mers once and reuse the index across the walk's many pairings
+    # (ops/seed.sorted_kmer_index).  None = no sharing (one-shot pairs,
+    # e.g. the border checks).  Purely a performance hint — never
+    # affects results.
+    t_token: object = None
 
 
 def _template_grp_gen(codes: np.ndarray, lens, offs, groups: List[LenGroup],
@@ -185,6 +192,10 @@ def ccs_prepare_gen(codes: np.ndarray, lens, offs, cfg: CcsConfig):
     template_len = int(lens[template_i])
     tseq = codes[template_offs:template_offs + template_len]
     t2seq = enc.revcomp_codes(tseq)
+    # per-template seeding tokens: every doubtful pass in the walk below
+    # aligns against tseq (then t2seq), so the executor can k-mer-sort
+    # each template once for the whole hole (ops/seed.py cache)
+    tok_f, tok_r = object(), object()
 
     segments = [Segment(template_offs, template_len, False)]
 
@@ -203,12 +214,14 @@ def ccs_prepare_gen(codes: np.ndarray, lens, offs, cfg: CcsConfig):
                 continue
             qseq = codes[seg.offs:seg.offs + seg.length]
             ok_f, rs = yield PairRequest(qseq, tseq,
-                                         cfg.strand_identity_pct)
+                                         cfg.strand_identity_pct,
+                                         t_token=tok_f)
             if ok_f:
                 reverse = False
             else:
                 ok_r, rs = yield PairRequest(qseq, t2seq,
-                                             cfg.strand_identity_pct)
+                                             cfg.strand_identity_pct,
+                                             t_token=tok_r)
                 if ok_r:
                     reverse = True
                 else:

@@ -317,8 +317,8 @@ def run_pipeline_sharded(in_path: str, out_path: str, cfg: CcsConfig,
         # share; round-robin: interleave-filter the shared full stream
         shard = (stream if range_lo is not None
                  else shard_stream(stream, rank, n))
-        rc = drive_batched(shard, writer, cfg, journal, metrics,
-                           inflight or cfg.zmw_microbatch)
+        # None = adaptive admission window (explicit --inflight pins)
+        rc = drive_batched(shard, writer, cfg, journal, metrics, inflight)
     if rc == 0:
         _write_done_marker(out_path, rank, n, journal.holes_done)
     return rc

@@ -183,14 +183,14 @@ def _recover_group(exc, idxs, key, dispatch, finish, host_one, results,
 
         if star_mod.force_scan_fallback(f"{type(exc).__name__}: {exc}") \
                 and metrics is not None:
-            metrics.compile_fallbacks += 1
+            metrics.bump(compile_fallbacks=1)
         return _run_group_sync(idxs, key, dispatch, finish, host_one,
                                results, metrics, depth, max_resplits,
                                backoff_s, compile_retried=True,
                                label=label)
     if kind == "oom" and depth < max_resplits and len(idxs) > 1:
         if metrics is not None:
-            metrics.oom_resplits += 1
+            metrics.bump(oom_resplits=1)
         print(f"[ccsx-tpu] device OOM on a {len(idxs)}-request group "
               f"{key}: resplitting (depth {depth + 1}): {exc}",
               file=sys.stderr)
@@ -206,7 +206,7 @@ def _recover_group(exc, idxs, key, dispatch, finish, host_one, results,
           f"path: {exc}", file=sys.stderr)
     for i in idxs:
         if metrics is not None:
-            metrics.host_fallbacks += 1
+            metrics.bump(host_fallbacks=1)
         try:
             with trace.span("host_replay", cat="recover",
                             group=label(key), reason=kind):
@@ -894,6 +894,12 @@ class PairExecutor:
     identical.
     """
 
+    # bounded LRU of per-template sorted k-mer indexes (keyed by
+    # PairRequest.t_token): the orientation walk pairs MANY passes
+    # against one hole's template across successive sweeps, and the
+    # token lets those sweeps share one sort (ops/seed.py)
+    seed_cache_max = 128
+
     def __init__(self, params: AlignParams, quant: int = 512,
                  metrics=None, warmup=None):
         self.params = params
@@ -902,6 +908,9 @@ class PairExecutor:
         self._warmup = warmup      # AOT precompiler (pipeline/warmup.py)
         self._warmed: set = set()  # inline-warm dedupe (no compiler)
         self._host_aligner = None  # built lazily, on first fallback
+        from collections import OrderedDict
+
+        self._seed_cache: "OrderedDict" = OrderedDict()
 
     def warm(self, pairs) -> None:
         """Precompile the padded pair-fill executables this pair list
@@ -935,6 +944,44 @@ class PairExecutor:
                                shape=f"N{N}", warmup=True):
             jax.block_until_ready(step(big, small))
 
+    def _seed_indexes(self, pairs):
+        """Per-pair sorted template k-mer indexes for this batch: cache
+        hits (token-keyed, LRU) cost nothing, misses are sorted in ONE
+        vectorized argsort over the whole batch
+        (seed.batch_sorted_indexes), and tokened misses enter the cache
+        for the walk's next pairing of the same template."""
+        from ccsx_tpu.ops import seed as seed_mod
+
+        indexes: Dict[int, tuple] = {}
+        need: List[int] = []          # pair idx needing a fresh sort
+        need_owner: Dict[object, int] = {}  # token -> representative idx
+        shared: List[tuple] = []      # (pair idx, token) cache/batch share
+        for i, pr in enumerate(pairs):
+            tok = getattr(pr, "t_token", None)
+            if tok is not None:
+                hit = self._seed_cache.get(tok)
+                if hit is not None:
+                    self._seed_cache.move_to_end(tok)
+                    indexes[i] = hit
+                    continue
+                if tok in need_owner:
+                    shared.append((i, tok))
+                    continue
+                need_owner[tok] = i
+            need.append(i)
+        if need:
+            for i, idx in zip(need, seed_mod.batch_sorted_indexes(
+                    [pairs[i].t for i in need])):
+                indexes[i] = idx
+                tok = getattr(pairs[i], "t_token", None)
+                if tok is not None:
+                    self._seed_cache[tok] = idx
+                    while len(self._seed_cache) > self.seed_cache_max:
+                        self._seed_cache.popitem(last=False)
+        for i, tok in shared:
+            indexes[i] = indexes[need_owner[tok]]
+        return indexes
+
     def run(self, pairs: List["prep_mod.PairRequest"]):
         """Satisfy all pair requests; results align index-for-index as
         (ok, MatchResult) tuples — the strand_match contract."""
@@ -943,8 +990,10 @@ class PairExecutor:
         results = [None] * len(pairs)
         groups: Dict[tuple, List[int]] = defaultdict(list)
         lines: Dict[int, np.ndarray] = {}
+        seed_idx = self._seed_indexes(pairs)
         for i, pr in enumerate(pairs):
-            hit = seed_mod.seed_diagonal(pr.q, pr.t)
+            hit = seed_mod.seed_diagonal(pr.q, pr.t,
+                                         t_index=seed_idx.get(i))
             if hit is None:
                 # no shared 13-mers: unalignable at >=60% identity
                 results[i] = (False, MatchResult(False, 0, 0, 0, 0, 0, 0, 0))
@@ -959,13 +1008,18 @@ class PairExecutor:
                     bucket_len(len(pr.t), self.quant))].append(i)
 
         if self.metrics is not None:
-            self.metrics.pair_alignments += len(lines)
-            self.metrics.device_dispatches += len(groups)
+            padded = real = 0
             for (qmax, tmax), idxs in groups.items():
                 N = _z_bucket(len(idxs))
-                self.metrics.dp_cells_padded += N * qmax * self.params.band
-                self.metrics.dp_cells_real += self.params.band * int(
+                padded += N * qmax * self.params.band
+                real += self.params.band * int(
                     sum(len(pairs[i].q) for i in idxs))
+            # bump(): the pair gate's pump thread runs this concurrently
+            # with the driver's refine sweeps (pipeline/prep_pool.py)
+            self.metrics.bump(pair_alignments=len(lines),
+                              device_dispatches=len(groups),
+                              dp_cells_padded=padded,
+                              dp_cells_real=real)
 
         def dispatch(idxs, key):
             qmax, tmax = key
@@ -1198,17 +1252,18 @@ class BatchExecutor:
         padded = Z * P * qmax * band * iters
         real = band * iters * int(
             sum(int(reqs[i].qlens[reqs[i].row_mask].sum()) for i in idxs))
-        self.metrics.dp_cells_padded += padded
-        self.metrics.dp_cells_real += real
         # round-only counters, all in CELL units (x qmax x band x iters)
         # so the length/pass/Z factorization is exact in aggregate
-        # across heterogeneous shape groups (metrics.py)
+        # across heterogeneous shape groups (metrics.py); bump() — the
+        # pair gate's pump thread updates the shared dp_cells_* family
+        # concurrently (pipeline/prep_pool.py)
         rows_real = int(sum(int(reqs[i].row_mask.sum()) for i in idxs))
         scale = qmax * band * iters
-        self.metrics.dp_round_cells_padded += padded
-        self.metrics.dp_round_cells_real += real
-        self.metrics.dp_rowcells_real += rows_real * scale
-        self.metrics.dp_rowcells_cap += len(idxs) * P * scale
+        self.metrics.bump(dp_cells_padded=padded, dp_cells_real=real,
+                          dp_round_cells_padded=padded,
+                          dp_round_cells_real=real,
+                          dp_rowcells_real=rows_real * scale,
+                          dp_rowcells_cap=len(idxs) * P * scale)
 
     def _count_cells_packed(self, reqs, idxs, qmax: int, R: int,
                             iters: int):
@@ -1225,16 +1280,13 @@ class BatchExecutor:
         rows_real = int(sum(int(reqs[i].row_mask.sum()) for i in idxs))
         real = band * iters * int(
             sum(int(reqs[i].qlens[reqs[i].row_mask].sum()) for i in idxs))
-        self.metrics.dp_cells_padded += R * scale
-        self.metrics.dp_cells_real += real
-        self.metrics.dp_round_cells_padded += R * scale
-        self.metrics.dp_round_cells_real += real
-        self.metrics.dp_rowcells_real += rows_real * scale
-        self.metrics.dp_rowcells_cap += R * scale
-        self.metrics.dp_rows_real += rows_real
-        self.metrics.dp_rows_dispatched += R
-        self.metrics.packed_dispatches += 1
-        self.metrics.packed_holes += len(idxs)
+        self.metrics.bump(dp_cells_padded=R * scale, dp_cells_real=real,
+                          dp_round_cells_padded=R * scale,
+                          dp_round_cells_real=real,
+                          dp_rowcells_real=rows_real * scale,
+                          dp_rowcells_cap=R * scale,
+                          dp_rows_real=rows_real, dp_rows_dispatched=R,
+                          packed_dispatches=1, packed_holes=len(idxs))
 
     def _count_cells_packed_fused(self, reqs, idxs, qmax: int, iters: int,
                                   R: int, n_slabs: int, n_slots: int):
@@ -1252,19 +1304,16 @@ class BatchExecutor:
         real = band * iters * int(
             sum(int(reqs[i].qlens[reqs[i].row_mask].sum()) for i in idxs))
         padded = n_slabs * R * scale
-        self.metrics.dp_cells_padded += padded
-        self.metrics.dp_cells_real += real
-        self.metrics.dp_round_cells_padded += padded
-        self.metrics.dp_round_cells_real += real
-        self.metrics.dp_rowcells_real += rows_real * scale
-        self.metrics.dp_rowcells_cap += n_slabs * R * scale
-        self.metrics.dp_rows_real += rows_real
-        self.metrics.dp_rows_dispatched += n_slabs * R
-        self.metrics.packed_dispatches += 1
-        self.metrics.packed_holes += len(idxs)
-        self.metrics.fused_waves += 1
-        self.metrics.fused_slabs_real += n_slabs
-        self.metrics.fused_slots += n_slots
+        self.metrics.bump(dp_cells_padded=padded, dp_cells_real=real,
+                          dp_round_cells_padded=padded,
+                          dp_round_cells_real=real,
+                          dp_rowcells_real=rows_real * scale,
+                          dp_rowcells_cap=n_slabs * R * scale,
+                          dp_rows_real=rows_real,
+                          dp_rows_dispatched=n_slabs * R,
+                          packed_dispatches=1, packed_holes=len(idxs),
+                          fused_waves=1, fused_slabs_real=n_slabs,
+                          fused_slots=n_slots)
 
     # ---- AOT warmup (pipeline/warmup.py): predict + precompile the
     # ---- canonical packed executables concurrently with ingest/prep ----
@@ -1520,7 +1569,7 @@ class BatchExecutor:
         if self.metrics is not None:
             # bare rounds (legacy/test path) count as dispatches only —
             # 'windows' counts RefineRequests (one per window attempt)
-            self.metrics.device_dispatches += len(groups)
+            self.metrics.bump(device_dispatches=len(groups))
 
         def dispatch(idxs, key):
             P, qmax, tmax = key
@@ -1588,8 +1637,8 @@ class BatchExecutor:
 
         results: List[Optional[RefineResult]] = [None] * len(requests)
         if self.metrics is not None:
-            self.metrics.windows += len(requests)
-            self.metrics.device_dispatches += len(groups)
+            self.metrics.bump(windows=len(requests),
+                              device_dispatches=len(groups))
 
         def dispatch(idxs, key):
             P, qmax, tmax, iters = key
@@ -1627,7 +1676,7 @@ class BatchExecutor:
                 req = requests[i]
                 if ovf[z]:
                     if self.metrics is not None:
-                        self.metrics.refine_overflows += 1
+                        self.metrics.bump(refine_overflows=1)
                     with trace.span("host_replay", cat="recover",
                                     reason="refine_overflow"):
                         results[i] = host_one(i)
@@ -1666,7 +1715,7 @@ class BatchExecutor:
         nrows = [int(r.row_mask.sum()) for r in requests]
         results: List[Optional[RefineResult]] = [None] * len(requests)
         if self.metrics is not None:
-            self.metrics.windows += len(requests)
+            self.metrics.bump(windows=len(requests))
 
         def host_one(i):
             req = requests[i]
@@ -1680,7 +1729,7 @@ class BatchExecutor:
                 # windowed driver never produces one) has no rows to
                 # pack — the host path is its spec
                 if self.metrics is not None:
-                    self.metrics.host_fallbacks += 1
+                    self.metrics.bump(host_fallbacks=1)
                 try:
                     with trace.span("host_replay", cat="recover",
                                     reason="no_rows"):
@@ -1747,7 +1796,7 @@ class BatchExecutor:
         self._warm_sweep_shapes(sweep_shapes)
 
         if self.metrics is not None:
-            self.metrics.device_dispatches += len(groups)
+            self.metrics.bump(device_dispatches=len(groups))
 
         def dispatch(idxs, key):
             qmax, tmax, iters, _ = key
@@ -1822,7 +1871,7 @@ class BatchExecutor:
                 r0 += n
                 if ovf[s]:
                     if self.metrics is not None:
-                        self.metrics.refine_overflows += 1
+                        self.metrics.bump(refine_overflows=1)
                     with trace.span("host_replay", cat="recover",
                                     reason="refine_overflow"):
                         results[i] = host_one(i)
@@ -1913,8 +1962,15 @@ def _finish(result):
     return enc.to_record(result)
 
 
+def _grow_window(window: int, cap: int, growth: int) -> int:
+    """One step of the reference's adaptive chunk policy scaled to the
+    admission window (main.c:686-691: 1024 -> x4 -> cap 16384, i.e.
+    start at cap/growth^2 and multiply by growth until the cap)."""
+    return min(window * max(2, int(growth)), cap)
+
+
 def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
-                  metrics: Metrics, inflight: int) -> int:
+                  metrics: Metrics, inflight: Optional[int] = None) -> int:
     """The batched scheduler loop over an open ZMW stream and writer.
 
     Shared by the single-process driver (run_pipeline_batched) and the
@@ -1922,13 +1978,36 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
     exposes ``put_at(idx, name, seq, qual)`` it receives each record's
     hole ordinal too (the distributed shard writer needs it to restore
     global order at merge time).
+
+    ``inflight``: an EXPLICIT admission window pins it (the old fixed
+    behavior); None selects the reference's adaptive chunk-growth
+    policy (main.c:686-691 scaled to cfg.zmw_microbatch as the cap:
+    start at cap/growth^2, multiply by cfg.chunk_growth per filled
+    admission round) so small inputs skip full-window admission latency
+    while big ones stay bounded.
+
+    Host prep runs on the background prep plane
+    (pipeline/prep_pool.py) unless cfg.prep_threads == 0: ingest +
+    the orientation walk + its pair alignments happen on pool threads
+    concurrently with this loop's device sweeps, and the driver only
+    pays ``t_prep_blocked`` when it has nothing dispatchable.  Output
+    bytes, ordered emission, and the journal invariant are identical
+    either way (tests/test_prep_overlap.py).
     """
     from ccsx_tpu.io import bam as bam_mod
     from ccsx_tpu.io import zmw as zmw_mod
+    from ccsx_tpu.pipeline.prep_pool import (PrepPool,
+                                             resolve_prep_threads)
 
-    # a non-positive in-flight window would make the admission condition
-    # permanently false and spin the scheduler forever
-    inflight = max(1, int(inflight))
+    # non-positive --inflight keeps its historical meaning of "use the
+    # default" (which is now the adaptive window), rather than pinning
+    # a degenerate 1-hole window
+    explicit_window = inflight is not None and int(inflight) > 0
+    cap = max(1, int(inflight) if explicit_window
+              else int(cfg.zmw_microbatch))
+    growth = max(2, int(getattr(cfg, "chunk_growth", 4)))
+    window = cap if explicit_window else max(1, cap // (growth * growth))
+    n_prep = resolve_prep_threads(cfg)
     # AOT warmup precompiler (--no-warmup disables): as soon as prep
     # yields a hole's first RefineRequest, the group's canonical
     # executables compile on this background thread, concurrently with
@@ -1951,9 +2030,10 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
 
     active: List[_Hole] = []
     finished: Dict[int, _Hole] = {}
-    next_idx = 0       # next hole index to admit
+    next_idx = 0       # next hole index to admit (inline-prep mode)
     next_emit = 0      # next hole index to write
     exhausted = False
+    pool = None        # PrepPool, constructed inside the try below
     rc = 0
 
     def emit_ready():
@@ -1962,6 +2042,8 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
             h = finished.pop(next_emit)
             if h.resumed:
                 next_emit += 1
+                if pool is not None:
+                    pool.release()
                 continue
             wrote = False
             if h.err is not None:
@@ -1984,6 +2066,15 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
             journal.retire(writer, wrote, metrics)
             metrics.tick()
             next_emit += 1
+            if pool is not None:
+                pool.release()  # free one slot of ingest-ahead budget
+
+    def admit(h):
+        if h.done:
+            finished[h.idx] = h
+        else:
+            warm_hole(h)
+            active.append(h)
 
     # the flight recorder (utils/trace.py): span JSONL under --trace,
     # and the stall watchdog + group attribution regardless — the
@@ -2011,44 +2102,97 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
             from ccsx_tpu.utils import telemetry
 
             telem = telemetry.start(metrics, cfg.telemetry_port)
+        if n_prep > 0:
+            # the overlapped prep plane: ingest + the orientation walk
+            # move to background threads (constructed after the tracer
+            # so its spans record, inside the try so its threads cannot
+            # leak past the finally)
+            pool = PrepPool(stream, cfg, pair_executor, metrics,
+                            threads=n_prep, max_outstanding=4 * cap,
+                            resume=resume)
         while True:
-            # admit up to the in-flight window; bound TOTAL outstanding
-            # holes (incl. instantly-finished ones parked for ordered
-            # emission) so a filtered run can't grow memory unboundedly
-            while (not exhausted and len(active) < inflight
-                   and next_idx - next_emit < 4 * inflight):
-                try:
-                    with metrics.timer("ingest"), \
-                            trace.span("ingest_hole", cat="ingest"):
-                        z = next(stream)
-                        faultinject.fire("ingest")
-                except StopIteration:
-                    exhausted = True
-                    break
-                metrics.holes_in += 1
-                h = _Hole(idx=next_idx, zmw=z)
-                next_idx += 1
-                if metrics.holes_in <= resume:
-                    h.done = h.resumed = True
-                else:
-                    # prep host work (grouping + first generator step)
-                    # timed as its own stage; the walk's pair alignments
-                    # are batched below (benchmarks/prep_share.py is the
-                    # criterion that forced this)
-                    with metrics.timer("prep"), \
-                            trace.span("prep_hole", cat="prep",
-                                       hole=str(z.hole)):
-                        _start_hole(h, cfg)
-                if h.done:
-                    finished[h.idx] = h
-                else:
-                    warm_hole(h)
-                    active.append(h)
+            admitted_full = False
+            if pool is not None:
+                # drain whatever prep has finished, up to the window —
+                # NEVER blocking here: with device work pending, the
+                # sweep must run while prep keeps working in background
+                while len(active) < window:
+                    h = pool.poll()
+                    if h is None:
+                        break
+                    admit(h)
+                admitted_full = len(active) >= window
+            else:
+                # inline prep (--prep-threads 0): admit up to the
+                # window; bound TOTAL outstanding holes (incl.
+                # instantly-finished ones parked for ordered emission)
+                # so a filtered run can't grow memory unboundedly
+                while (not exhausted and len(active) < window
+                       and next_idx - next_emit < 4 * cap):
+                    try:
+                        with metrics.timer("ingest"), \
+                                trace.span("ingest_hole", cat="ingest"):
+                            z = next(stream)
+                            faultinject.fire("ingest")
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    metrics.holes_in += 1
+                    h = _Hole(idx=next_idx, zmw=z)
+                    next_idx += 1
+                    if metrics.holes_in <= resume:
+                        h.done = h.resumed = True
+                    else:
+                        # prep host work (grouping + first generator
+                        # step) timed as its own stage AND as driver-
+                        # blocked prep (inline prep is all critical
+                        # path); the walk's pair alignments are batched
+                        # below (benchmarks/prep_share.py is the
+                        # criterion that forced this)
+                        with metrics.timer("prep"), \
+                                metrics.timer("prep_blocked"), \
+                                trace.span("prep_hole", cat="prep",
+                                           hole=str(z.hole)):
+                            _start_hole(h, cfg)
+                    admit(h)
+                admitted_full = len(active) >= window
             emit_ready()
             if not active:
-                if exhausted:
+                if pool is None:
+                    if exhausted:
+                        break
+                    continue
+                if pool.drained():
                     break
-                continue
+                # nothing dispatchable: the driver is genuinely blocked
+                # on prep — the critical-path seconds prep_share reads.
+                # Accumulate while prep keeps DELIVERING (sweeping the
+                # first hole the instant it appears would fragment the
+                # sweep into near-empty slabs and per-hole dispatches);
+                # the moment prep pauses with work in hand — or the
+                # window fills — sweep what we have.
+                while len(active) < window and not pool.drained():
+                    # only the wait itself books as blocked — emission
+                    # (write + journal fsync) has its own stage, and
+                    # prep_share is the acceptance counter
+                    with metrics.timer("prep_blocked"):
+                        h = pool.get(timeout=0.05 if active else 1.0)
+                    # emit as we accumulate: instantly-done holes
+                    # (resumed/skipped) must retire HERE to keep
+                    # releasing ingest budget, or a done stretch longer
+                    # than the 4x bound live-locks against the pool
+                    emit_ready()
+                    if h is None:
+                        if active:
+                            break
+                        metrics.heartbeat()
+                        continue
+                    admit(h)
+                # a window filled while blocked still earns growth
+                admitted_full = len(active) >= window
+                metrics.heartbeat()
+                if not active:
+                    continue
             # one batched sweep over every pending request, split by
             # kind: prep pair alignments (strand_match walks) and
             # consensus rounds each batch across holes
@@ -2057,7 +2201,11 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
             round_holes = [h for h in active
                            if not isinstance(h.req, prep_mod.PairRequest)]
             if pair_holes:
+                # inline-mode only in practice (the pool finishes the
+                # walk before handing a hole over); this sweep blocks
+                # the driver, so it books as prep_blocked as well
                 with metrics.timer("prep"), \
+                        metrics.timer("prep_blocked"), \
                         trace.span("pair_sweep", cat="prep",
                                    n=len(pair_holes)):
                     pres = pair_executor.run([h.req for h in pair_holes])
@@ -2081,6 +2229,10 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
                     still.append(h)
             active = still
             emit_ready()
+            if not explicit_window and admitted_full and window < cap:
+                # adaptive chunk growth (main.c:686-691 semantics): a
+                # filled admission round earns the next window size
+                window = _grow_window(window, cap, growth)
             # interval-driven progress events even while nothing has
             # retired yet (a holes<=inflight run drains at the very end)
             metrics.heartbeat()
@@ -2099,6 +2251,11 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
         # settle the (possibly rate-limit-lagging) cursor AFTER the
         # writer has made the records durable
         journal.close()
+        # stop the prep plane first (its workers/pump write prep spans
+        # and metrics): error paths may leave in-prep holes — dropped,
+        # the rc already reflects the failure
+        if pool is not None:
+            pool.close()
         # stop the warmup thread (drops queued compiles; an in-flight
         # build finishes) BEFORE the tracer closes, so no warmup span
         # outlives the trace file
@@ -2174,5 +2331,5 @@ def run_pipeline_batched(in_path: str, out_path: str, cfg: CcsConfig,
         print(f"Cannot open file for write! ({e})", file=sys.stderr)
         metrics.close_stream()
         return 1
-    return drive_batched(stream, writer, cfg, journal, metrics,
-                         inflight or cfg.zmw_microbatch)
+    # None = the adaptive admission window (explicit --inflight pins it)
+    return drive_batched(stream, writer, cfg, journal, metrics, inflight)

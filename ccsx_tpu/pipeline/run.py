@@ -128,6 +128,13 @@ def open_writer(path: str, append: bool, bam: bool = False,
 
 def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
                  journal_path: Optional[str] = None) -> int:
+    if getattr(cfg, "prep_threads", None):
+        # the per-hole path already overlaps prep with compute through
+        # its -j worker pool (each worker preps + computes whole holes);
+        # the prep plane is a batched-scheduler construct
+        print("[ccsx-tpu] --prep-threads has no effect with --batch off "
+              "(use -j; the per-hole path overlaps prep per worker)",
+              file=sys.stderr)
     # metrics constructed before the stream so both ingest paths can
     # book their filtered-hole accounting into it
     metrics = Metrics(verbose=cfg.verbose, stream=cfg.metrics_stream())

@@ -135,12 +135,31 @@ class Metrics:
     ingest_bytes: int = 0
     # per-stage wall time (SURVEY.md §5.1: the reference has no stage
     # timing; the pipeline analog of its read/compute/write steps).
-    # Attribution is at the driver loop: with worker threads, t_compute
-    # is the driver's wall time blocked on compute results.
+    # Attribution is at the driver loop — except ingest and prep, which
+    # the prep plane (pipeline/prep_pool.py) runs on background threads
+    # when it is on: t_ingest/t_prep then sum WORK seconds across those
+    # threads (overlapped with device compute, so not comparable with
+    # an inline-mode run's critical-path seconds), while t_prep_blocked
+    # below keeps the critical-path story.  Ingest gets no blocked twin:
+    # it is measured ~0% of wall on every artifact, and a driver starved
+    # by it shows up in prep_blocked (the pool delivers nothing).
     t_ingest: float = 0.0
     t_prep: float = 0.0     # host orientation/clip (ccs_prepare analog)
     t_compute: float = 0.0
     t_write: float = 0.0
+    # prep plane (ISSUE 8): driver wall spent BLOCKED on prep — inline
+    # prep when the pool is off (t_prep_blocked == t_prep there), or
+    # waiting on the pool's ready queue with nothing dispatchable when
+    # it is on.  prep_share = t_prep_blocked / elapsed is the
+    # critical-path prep share the <= 0.10 acceptance bar reads;
+    # prep_overlap_share = 1 - blocked/worked is how much of the prep
+    # work the overlap hid.
+    t_prep_blocked: float = 0.0
+    # live prep-plane gauges: holes prepped-and-waiting for the driver
+    # (current + high-water) and the pool width (0 = inline prep)
+    prep_queue_depth: int = 0
+    prep_queue_peak: int = 0
+    prep_threads: int = 0
     # a "progress" JSONL event is emitted every progress_every retired
     # holes (0 disables); "final" is always emitted at report().  The
     # live-telemetry plane also emits one every progress_interval_s
@@ -185,6 +204,24 @@ class Metrics:
     # emit() runs on the driver thread AND the stall-watchdog thread
     _emit_lock: threading.Lock = dataclasses.field(
         default_factory=threading.Lock, repr=False)
+    # counter/stage updates arrive from the driver, the prep-pool
+    # workers, and the pair-gate pump concurrently; += on an attribute
+    # is a racy read-modify-write, so concurrent writers go through
+    # bump()/add_stage() under this lock
+    _count_lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock, repr=False)
+
+    def bump(self, **deltas) -> None:
+        """Atomically add deltas to counter fields (thread-safe +=)."""
+        with self._count_lock:
+            for k, v in deltas.items():
+                setattr(self, k, getattr(self, k) + v)
+
+    def add_stage(self, stage: str, seconds: float) -> None:
+        """Thread-safe accumulation into t_<stage>."""
+        attr = "t_" + stage
+        with self._count_lock:
+            setattr(self, attr, getattr(self, attr) + seconds)
 
     @contextlib.contextmanager
     def timer(self, stage: str):
@@ -193,9 +230,7 @@ class Metrics:
         try:
             yield
         finally:
-            attr = "t_" + stage
-            setattr(self, attr, getattr(self, attr)
-                    + time.perf_counter() - t0)
+            self.add_stage(stage, time.perf_counter() - t0)
 
     # windowed-rate sampling: coalesce ring samples closer than this
     # (a fast run must not shrink the window to microseconds), and keep
@@ -340,6 +375,17 @@ class Metrics:
             "prep_s": round(self.t_prep, 6),
             "compute_s": round(self.t_compute, 6),
             "write_s": round(self.t_write, 6),
+            # prep plane: critical-path prep exposure + overlap quality
+            # (None overlap until any prep work exists).  prep_share is
+            # the acceptance counter: blocked-on-prep wall / elapsed
+            "prep_blocked_s": round(self.t_prep_blocked, 6),
+            "prep_share": round(self.t_prep_blocked / self.elapsed, 4),
+            "prep_overlap_share": round(
+                1.0 - min(self.t_prep_blocked / self.t_prep, 1.0), 4)
+                                  if self.t_prep else None,
+            "prep_queue_depth": self.prep_queue_depth,
+            "prep_queue_peak": self.prep_queue_peak,
+            "prep_threads": self.prep_threads,
             "elapsed_s": round(self.elapsed, 3),
             "zmws_per_sec": round(self.zmws_per_sec, 3),
             "progress": self.progress_snapshot(),

@@ -60,6 +60,7 @@ _SLOTS = (("#2a78d6", "#3987e5"), ("#eb6834", "#d95926"),
 REPORT_TILE_KEYS = (
     "zmws_per_sec", "dp_occupancy", "dp_row_fill",
     "packed_holes_per_dispatch", "fused_slot_fill", "compile_share",
+    "prep_share", "prep_overlap_share",
     "distinct_slab_shapes", "holes_filtered",
 )
 # final-event counters the header table renders

@@ -71,6 +71,10 @@ PROM_GAUGES = (
     "packed_holes_per_dispatch", "fused_slot_fill",
     "ingest_s", "prep_s", "compute_s", "write_s", "elapsed_s",
     "zmws_per_sec", "compile_s", "compile_share",
+    # prep plane (pipeline/prep_pool.py): critical-path prep exposure,
+    # overlap quality, and the live ready-queue gauges
+    "prep_blocked_s", "prep_share", "prep_overlap_share",
+    "prep_queue_depth", "prep_queue_peak", "prep_threads",
 )
 # snapshot keys with dedicated (non-scalar) renderings
 PROM_STRUCTURED = ("groups", "groups_forced", "degraded", "progress",

@@ -84,7 +84,8 @@ CATEGORIES = ("ingest", "prep", "compute", "device", "recover", "write",
 # can prove a Metrics rename cannot silently zero a stats column
 OCCUPANCY_KEYS = ("dp_occupancy", "dp_round_occupancy", "dp_length_fill",
                   "dp_pass_fill", "dp_z_fill", "dp_row_fill",
-                  "packed_holes_per_dispatch", "zmws_per_sec",
+                  "packed_holes_per_dispatch", "prep_share",
+                  "prep_overlap_share", "zmws_per_sec",
                   "device_dispatches", "holes_out", "elapsed_s")
 
 _current: Optional["Tracer"] = None
