@@ -1,0 +1,27 @@
+# Repo-level convenience targets (the native layer has its own
+# Makefile at ccsx_tpu/native/Makefile, auto-invoked on import).
+
+PY ?= python
+PYTEST_FLAGS = -q -p no:cacheprovider -p no:xdist -p no:randomly
+
+.PHONY: chaos chaos-soak tier1 native
+
+# the deterministic tier-1 chaos slice (tests/test_chaos.py fast
+# tests): seeded fault schedules through the full CLI with the
+# byte-identity oracle — the recovery ladder, dispatch deadline,
+# circuit breaker, and shepherd restart in one command
+chaos:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py -m 'not slow' $(PYTEST_FLAGS)
+
+# the full randomized soak (also available directly:
+# python benchmarks/chaos.py --seed N --trials T)
+chaos-soak:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py $(PYTEST_FLAGS)
+	JAX_PLATFORMS=cpu $(PY) benchmarks/chaos.py --seed 0 --trials 8 --holes 4
+
+# the ROADMAP tier-1 suite (same flags as the verify command)
+tier1:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -m 'not slow' --continue-on-collection-errors $(PYTEST_FLAGS)
+
+native:
+	$(MAKE) -C ccsx_tpu/native

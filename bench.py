@@ -145,18 +145,38 @@ def compare_with_prev(line, prev, artifact):
                     "path)")
         if prep_cmp:
             vp["prep_share"] = prep_cmp
+        # breaker/hang-rescued runs are not perf numbers: a config that
+        # completed via an open circuit breaker (or abandoned, host-
+        # replayed dispatches) measured the HOST path's wall, not the
+        # device's — flag it and keep it out of the ratio geomean
+        rescued = []
         for e in line.get("e2e", []):
             pe = prev_e2e.get(e.get("config"))
+            cur_rescued = bool(e.get("breaker_trips")
+                               or e.get("device_hangs"))
+            prev_rescued = bool(pe and (pe.get("breaker_trips")
+                                        or pe.get("device_hangs")))
+            if cur_rescued:
+                rescued.append(str(e.get("config")))
             if (not pe or not pe.get("zmws_per_sec")
                     or not e.get("zmws_per_sec")
                     or pe.get("holes_in") != e.get("holes_in")
                     # traced runs force per-dispatch execution; their
                     # wall numbers are a different discipline than the
                     # untraced async overlap — never cross-compare
-                    or bool(pe.get("traced")) != bool(e.get("traced"))):
+                    or bool(pe.get("traced")) != bool(e.get("traced"))
+                    or cur_rescued or prev_rescued):
                 continue
             ratios[str(e["config"])] = round(
                 e["zmws_per_sec"] / pe["zmws_per_sec"], 3)
+        if rescued:
+            vp["breaker_rescued_configs"] = rescued
+            print("[bench] WARNING: e2e config(s) "
+                  + ",".join(rescued) + " completed only via the "
+                  "resilience layer (open breaker / abandoned "
+                  "dispatches); their wall times measure the host "
+                  "path and are excluded from vs_prev",
+                  file=sys.stderr)
         if ratios:
             import math
 

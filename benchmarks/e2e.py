@@ -136,6 +136,12 @@ def run_config(config: int, n_holes: int, batch: str, seed: int = 0,
             # claims carry their own evidence
             "groups": final.get("groups"),
             "degraded": final.get("degraded"),
+            # resilient execution (pipeline/resilience.py): a run that
+            # completed only via abandoned dispatches or an open
+            # circuit breaker produced host-path wall time — bench.py's
+            # vs_prev refuses to read it as a comparable perf number
+            "device_hangs": final.get("device_hangs"),
+            "breaker_trips": final.get("breaker_trips"),
             # tracing forces per-dispatch execution (Span.force), a
             # different discipline than the async untraced overlap —
             # recorded so vs_prev never compares across the two

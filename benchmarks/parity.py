@@ -1,0 +1,211 @@
+"""Reference-parity harness (VERDICT Missing #1).
+
+Runs the five BASELINE configs (benchmarks/e2e.py make_input — the
+synthetic stand-ins for the baseline plan's workloads) through BOTH
+tools — this repo's ``ccsx-tpu`` and a *built reference binary*
+(``110allan/ccsx``) — and reports, per hole:
+
+* ``identity_cross``  — global-alignment identity between the two
+  tools' consensus sequences (the headline parity number);
+* ``identity_tpu`` / ``identity_ref`` — each tool's consensus vs the
+  TRUE synthetic template (the oracle the reference never has on real
+  data, and the tie-breaker when the tools disagree);
+* Q20 yield — for each tool, the fraction of holes whose EMPIRICAL
+  per-base error vs the template is <= 1e-2 (Q20-equivalent accuracy).
+  The reference emits FASTA only (main.c:714), so predicted-QV yield
+  exists for our side alone (``q20_pred_tpu``, from a --fastq run) and
+  the cross-tool delta is taken on the empirical yields
+  (``q20_yield_delta = ours - reference``).
+
+The reference binary is NOT buildable in this container (its bsalign
+dependency clones at build time — no network), so this harness takes
+the binary as an argument and is shipped with a STUB-binary test
+(tests/test_parity.py) that proves the mechanics run end-to-end the
+first day a real ``ccsx`` is available:
+
+    python benchmarks/parity.py --ccsx /path/to/ccsx \
+        [--holes 8] [--configs 1,2,3,4,5] [--json parity.json]
+
+Binary contract assumed (SURVEY §2.1 row 1): ``ccsx [options] INPUT
+OUTPUT`` with the same short flags (-A -P -m -M -c), FASTA output with
+``movie/hole/ccs`` record names.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "benchmarks"))
+
+from ccsx_tpu import cli                                     # noqa: E402
+from ccsx_tpu.io import fastx                                # noqa: E402
+from ccsx_tpu.ops import encode as enc                       # noqa: E402
+from ccsx_tpu.utils import synth                             # noqa: E402
+
+Q20_ERR = 1e-2   # empirical per-base error at Q20
+
+
+def _read_consensus(path: str) -> dict:
+    """{movie/hole: 2-bit codes} from a FASTA/FASTQ output."""
+    out = {}
+    for r in fastx.read_fastx(path):
+        name = r.name[:-4] if r.name.endswith("/ccs") else r.name
+        out[name] = enc.encode(r.seq)
+    return out
+
+
+def _read_quals(path: str) -> dict:
+    """{movie/hole: np.uint8 phred} from a FASTQ output."""
+    out = {}
+    for r in fastx.read_fastx(path):
+        if r.qual is None:
+            continue
+        name = r.name[:-4] if r.name.endswith("/ccs") else r.name
+        out[name] = np.frombuffer(r.qual, np.uint8) - 33
+    return out
+
+
+def _identity(a, b) -> float:
+    """Orientation-agnostic global identity (consensus strand follows
+    the chosen template pass — an arbitrary strand in both tools)."""
+    if a is None or b is None or len(a) == 0 or len(b) == 0:
+        return 0.0
+    return synth.identity_either(a, b)
+
+
+def _err_rate(cons, template) -> float:
+    """Empirical per-base error of a consensus vs the true template
+    (best orientation): 1 - identity, on the aligned columns."""
+    return max(1.0 - _identity(cons, template), 0.0)
+
+
+def run_config_parity(config: int, ccsx_bin: str, n_holes: int,
+                      seed: int = 0, timeout_s: float = 600.0) -> dict:
+    from e2e import make_input
+
+    rng = np.random.default_rng(seed)
+    with tempfile.TemporaryDirectory() as tmp:
+        in_path, args, zs = make_input(config, n_holes, rng, tmp)
+        templates = {f"{z.movie}/{z.hole}": z.template for z in zs}
+        ours = os.path.join(tmp, "ours.fa")
+        ours_fq = os.path.join(tmp, "ours.fq")
+        theirs = os.path.join(tmp, "ref.fa")
+        rc = cli.main([*args, "--batch", "on", in_path, ours])
+        assert rc == 0, f"ccsx-tpu config {config} rc={rc}"
+        # predicted-QV side ride-along (FASTA configs only; the
+        # reference has no quality output to mirror)
+        rc = cli.main([*args, "--batch", "on", "--fastq", in_path,
+                       ours_fq])
+        pred_quals = _read_quals(ours_fq) if rc == 0 else {}
+        r = subprocess.run([ccsx_bin, *args, in_path, theirs],
+                           capture_output=True, text=True,
+                           timeout=timeout_s)
+        if r.returncode != 0:
+            return {"config": config, "error":
+                    f"reference binary rc={r.returncode}: "
+                    f"{(r.stderr or '')[-500:]}"}
+        a = _read_consensus(ours)
+        b = _read_consensus(theirs)
+        holes = []
+        for name, template in templates.items():
+            ca, cb = a.get(name), b.get(name)
+            if ca is None and cb is None:
+                continue   # both tools filtered/skipped it: agreement
+            pq = pred_quals.get(name)
+            holes.append({
+                "hole": name,
+                "emitted_tpu": ca is not None,
+                "emitted_ref": cb is not None,
+                "identity_cross": round(_identity(ca, cb), 5),
+                "identity_tpu": round(_identity(ca, template), 5),
+                "identity_ref": round(_identity(cb, template), 5),
+                "err_tpu": round(_err_rate(ca, template), 6),
+                "err_ref": round(_err_rate(cb, template), 6),
+                # predicted Q20 yield: fraction of OUR bases called
+                # at predicted Q >= 20 (reference: no quals exist)
+                "q20_pred_tpu": (round(float((pq >= 20).mean()), 4)
+                                 if pq is not None and len(pq) else None),
+            })
+        n = len(holes)
+        q20_tpu = (sum(h["emitted_tpu"] and h["err_tpu"] <= Q20_ERR
+                       for h in holes) / n) if n else None
+        q20_ref = (sum(h["emitted_ref"] and h["err_ref"] <= Q20_ERR
+                       for h in holes) / n) if n else None
+        return {
+            "config": config,
+            "holes": holes,
+            "n_holes": n,
+            "n_identical": sum(h["identity_cross"] >= 1.0
+                               for h in holes),
+            "mean_identity_cross": round(float(np.mean(
+                [h["identity_cross"] for h in holes])), 5) if n else None,
+            "mean_identity_tpu": round(float(np.mean(
+                [h["identity_tpu"] for h in holes])), 5) if n else None,
+            "mean_identity_ref": round(float(np.mean(
+                [h["identity_ref"] for h in holes])), 5) if n else None,
+            # empirical Q20-equivalent yield per tool + the delta the
+            # VERDICT asked for (ours - reference; positive = we call
+            # more holes at Q20-accuracy than the reference does)
+            "q20_yield_tpu": round(q20_tpu, 4) if n else None,
+            "q20_yield_ref": round(q20_ref, 4) if n else None,
+            "q20_yield_delta": (round(q20_tpu - q20_ref, 4)
+                                if n else None),
+        }
+
+
+def run_parity(ccsx_bin: str, n_holes: int, configs, seed: int = 0,
+               timeout_s: float = 600.0) -> dict:
+    if not (os.path.isfile(ccsx_bin)
+            and os.access(ccsx_bin, os.X_OK)):
+        raise FileNotFoundError(
+            f"reference binary {ccsx_bin!r} missing or not executable")
+    results = [run_config_parity(c, ccsx_bin, n_holes, seed=seed,
+                                 timeout_s=timeout_s) for c in configs]
+    usable = [r for r in results if "error" not in r and r["n_holes"]]
+    return {
+        "ccsx_bin": os.path.abspath(ccsx_bin),
+        "holes_per_config": n_holes,
+        "seed": seed,
+        "configs": results,
+        "mean_identity_cross": round(float(np.mean(
+            [r["mean_identity_cross"] for r in usable])), 5)
+            if usable else None,
+        "q20_yield_delta": round(float(np.mean(
+            [r["q20_yield_delta"] for r in usable
+             if r["q20_yield_delta"] is not None])), 4)
+            if usable else None,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Reference-parity harness: run the five BASELINE "
+                    "configs through ccsx-tpu AND a built ccsx binary, "
+                    "report per-hole identity + Q20-yield deltas")
+    ap.add_argument("--ccsx", required=True,
+                    help="path to a built reference ccsx binary")
+    ap.add_argument("--holes", type=int, default=8)
+    ap.add_argument("--configs", default="1,2,3,4,5")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json", default=None)
+    a = ap.parse_args()
+    configs = [int(x) for x in a.configs.split(",") if x]
+    summary = run_parity(a.ccsx, a.holes, configs, seed=a.seed)
+    print(json.dumps(summary, indent=1))
+    if a.json:
+        with open(a.json, "w") as f:
+            json.dump(summary, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,6 +57,26 @@ TPU extensions (long options):
                            /progress JSON with the windowed-rate ETA;
                            auto-bumps when taken, per-rank offset under
                            --hosts; 0 = off) [0]
+--dispatch-deadline <sec> (bounded-wait device dispatch: a call open
+                           past the deadline is ABANDONED — thread
+                           parked, result discarded — and its group
+                           replays on the bit-exact host path; first
+                           call of a shape gets 10x for cold compiles;
+                           0 = off: a wedged dispatch stalls forever,
+                           today's behavior) [0]
+--breaker-strikes <int>   (backend circuit breaker: this many device
+                           failures — hangs, OOM ladder-bottoms,
+                           compile failures — within 60s trip the
+                           breaker and remaining work runs on the host
+                           path; 0 disables) [3]
+--breaker-probe-s <sec>   (half-open re-probe interval for a tripped
+                           breaker: one group is dispatched as a probe,
+                           success closes the breaker; 0 = stay open
+                           for the rest of the run) [0]
+--max-failed-holes <v>    (failure-rate abort: an integer count >= 0
+                           or a fraction in (0,1) of processed holes;
+                           exceeding it exits rc 2 instead of emitting
+                           a near-empty output at rc 0) [unbounded]
 --hosts <int> --host-id <int> --coordinator <addr> --merge-shards <N>
 --merge-unmarked          (merge a legacy shard set without .done markers)
 --make-index              (index INPUT for byte-range sharded ingest)
@@ -79,6 +99,16 @@ TPU extensions (long options):
 --inject-faults p@N,...   (deterministic fault injection; testing only)
 
 Subcommands:
+ccsx-tpu shepherd --hosts N [opts] <INPUT> <OUTPUT>
+                          (rank supervisor for sharded runs: launches
+                           the N ranks as subprocesses, monitors
+                           shard-journal heartbeats + per-rank
+                           /healthz, restarts dead or stalled ranks
+                           with exponential backoff up to
+                           --max-rank-restarts — they resume from
+                           their shard journals — then auto-merges;
+                           turns merge_shards' "re-run the dead rank"
+                           instruction into a supervised loop)
 ccsx-tpu stats <jsonl>... (summarize --trace / --metrics artifacts:
                            shape-group attribution table, stage
                            breakdown, occupancy recap, slowest
@@ -241,11 +271,41 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Build INPUT's BGZF hole index sidecar "
                         "(<INPUT>.ccsx_idx) for byte-range sharded "
                         "multi-host ingest, then exit")
+    # resilient execution (pipeline/resilience.py)
+    p.add_argument("--dispatch-deadline", type=float, default=0.0,
+                   dest="dispatch_deadline", metavar="SEC",
+                   help="Bounded-wait device dispatch: abandon a call "
+                        "open past this deadline (thread parked, "
+                        "result discarded) and replay its group on the "
+                        "bit-exact host path; the first call of each "
+                        "shape gets 10x for cold compiles.  0 = off — "
+                        "a wedged dispatch stalls the run forever, "
+                        "with the watchdog observing only [0]")
+    p.add_argument("--breaker-strikes", type=int, default=None,
+                   dest="breaker_strikes", metavar="N",
+                   help="Backend circuit breaker: N device failures "
+                        "(hangs, OOM ladder-bottoms, compile failures) "
+                        "within 60s trip it open — remaining work runs "
+                        "on the host path.  0 disables [3]")
+    p.add_argument("--breaker-probe-s", type=float, default=None,
+                   dest="breaker_probe_s", metavar="SEC",
+                   help="Half-open re-probe interval for a tripped "
+                        "breaker: one group dispatches as a probe and "
+                        "success closes it.  0 = stay open for the "
+                        "rest of the run [0]")
+    p.add_argument("--max-failed-holes", default=None,
+                   dest="max_failed_holes", metavar="V",
+                   help="Failure-rate abort: an integer count (>= 0, "
+                        "checked per failure) or a fraction of "
+                        "processed holes in (0, 1) (checked at end of "
+                        "run).  Exceeding it exits rc 2 instead of "
+                        "emitting a near-empty output at rc 0 "
+                        "[unbounded]")
     p.add_argument("--inject-faults", default=None, metavar="SPEC",
                    help="Deterministic fault injection for testing "
                         "recovery paths: point@N[+],... with points "
-                        "ingest, compute, device_oom, stall, write, "
-                        "journal "
+                        "ingest, compute, device_oom, stall, "
+                        "device_hang, rank_death, write, journal "
                         "(utils/faultinject.py; CCSX_FAULTS env "
                         "equivalent)")
     return p
@@ -317,6 +377,41 @@ def config_from_args(args) -> CcsConfig:
         print(f"Error: --prep-threads must be in [0, 64], got "
               f"{prep_threads}", file=sys.stderr)
         raise SystemExit(1)
+    dispatch_deadline = getattr(args, "dispatch_deadline", 0.0) or 0.0
+    if dispatch_deadline < 0:
+        print(f"Error: --dispatch-deadline must be >= 0, got "
+              f"{dispatch_deadline}", file=sys.stderr)
+        raise SystemExit(1)
+    breaker_strikes = getattr(args, "breaker_strikes", None)
+    if breaker_strikes is not None and breaker_strikes < 0:
+        print(f"Error: --breaker-strikes must be >= 0, got "
+              f"{breaker_strikes}", file=sys.stderr)
+        raise SystemExit(1)
+    breaker_probe = getattr(args, "breaker_probe_s", None)
+    if breaker_probe is not None and breaker_probe < 0:
+        print(f"Error: --breaker-probe-s must be >= 0, got "
+              f"{breaker_probe}", file=sys.stderr)
+        raise SystemExit(1)
+    max_failed = getattr(args, "max_failed_holes", None)
+    if max_failed is not None:
+        import math
+
+        try:
+            max_failed = float(max_failed)
+            # reject what the semantics cannot honor: non-finite values
+            # (would crash int()/comparisons mid-run), negatives, and
+            # non-integer counts > 1 (int() would silently truncate
+            # 1.5 to a tighter budget than asked).  0 is a valid count:
+            # "no failures tolerated".
+            if (not math.isfinite(max_failed) or max_failed < 0
+                    or (max_failed >= 1
+                        and max_failed != int(max_failed))):
+                raise ValueError
+        except ValueError:
+            print("Error: --max-failed-holes expects an integer count "
+                  ">= 0 or a fraction in (0, 1), got "
+                  f"{args.max_failed_holes!r}", file=sys.stderr)
+            raise SystemExit(1)
     return CcsConfig(
         min_subread_len=args.min_len,
         max_subread_len=args.max_len,
@@ -342,6 +437,12 @@ def config_from_args(args) -> CcsConfig:
         pass_packing=pass_buckets is None,
         warmup_compile=not getattr(args, "no_warmup", False),
         prep_threads=prep_threads,
+        dispatch_deadline_s=dispatch_deadline,
+        max_failed_holes=max_failed,
+        **({"breaker_strikes": breaker_strikes}
+           if breaker_strikes is not None else {}),
+        **({"breaker_probe_s": breaker_probe}
+           if breaker_probe is not None else {}),
         **({"pass_buckets": pass_buckets} if pass_buckets else {}),
         **({"slab_rows": slab_rows} if slab_rows else {}),
         **({"slab_shape_ladder": slab_ladder}
@@ -352,6 +453,12 @@ def config_from_args(args) -> CcsConfig:
 def main(argv: Optional[list] = None) -> int:
     if argv is None:
         argv = sys.argv[1:]
+    if argv and argv[0] == "shepherd":
+        # rank supervisor for sharded runs: subprocess ranks, heartbeat
+        # monitoring, restart-with-backoff, auto-merge
+        from ccsx_tpu.pipeline.supervisor import shepherd_main
+
+        return shepherd_main(argv[1:])
     if argv and argv[0] == "stats":
         # trace/metrics JSONL summarizer subcommand (no jax import, no
         # backend init — safe on a host whose accelerator is hung)

@@ -177,6 +177,30 @@ class CcsConfig:
     stall_timeout_s: float = 120.0      # CLI --stall-timeout: the hang
     #   watchdog fires when a device-dispatch span stays open this long,
     #   dumping thread stacks + the in-flight shape group (0 disables)
+    # ---- resilient execution (pipeline/resilience.py; the reference
+    #      has no failure story at all beyond abort-or-soldier-on) ----
+    dispatch_deadline_s: float = 0.0    # CLI --dispatch-deadline: bound
+    #   every device dispatch/materialize wait; on expiry the wedged
+    #   call is abandoned (thread parked, result discarded) and the
+    #   group replays on the bit-exact host path.  First call of each
+    #   (group, phase) gets the compile grace (x10, like the stall
+    #   watchdog).  0 = off: a hung dispatch stalls the run forever
+    #   (the watchdog observes but never kills — today's behavior)
+    breaker_strikes: int = 3            # CLI --breaker-strikes: device
+    #   failures (hangs, OOM ladder-bottoms, compile failures) within
+    #   breaker_window_s that trip the circuit breaker open — remaining
+    #   work runs on the host path.  0 disables the breaker
+    breaker_window_s: float = 60.0      # strike-counting window
+    breaker_probe_s: float = 0.0        # CLI --breaker-probe-s: half-
+    #   open re-probe interval for a tripped breaker (one group is
+    #   dispatched as a probe; success closes the breaker).  0 = a
+    #   tripped breaker stays open for the rest of the run
+    max_failed_holes: Optional[float] = None  # CLI --max-failed-holes:
+    #   quarantine budget — an integer count (>= 0, checked per
+    #   failure) or a fraction of processed holes in (0, 1) (checked at
+    #   end of run / against a known total).  Exceeding it aborts with
+    #   rc 2 (exitcodes.RC_FAILED_HOLES) instead of emitting a
+    #   near-empty output at rc 0.  None = unbounded (historical)
     telemetry_port: int = 0             # CLI --telemetry-port: live
     #   telemetry endpoints (utils/telemetry.py — GET /metrics
     #   Prometheus text, /healthz ok|degraded, /progress JSON) served

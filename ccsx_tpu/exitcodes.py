@@ -1,0 +1,33 @@
+"""Process exit-code taxonomy — THE reference for what a ccsx-tpu rc
+means, pinned by tests/test_resilience.py and documented in README +
+ARCHITECTURE.md "Failure domains" so the codes cannot drift silently.
+
+Codes:
+
+* ``RC_OK`` (0) — the run completed and the output is trustworthy.
+  NOTE: rc 0 does NOT mean the run was incident-free — quarantined
+  holes, OOM resplits, host fallbacks, abandoned (hung) dispatches, and
+  an open circuit breaker all still exit 0, because the output bytes
+  are correct either way (the host path is the bit-exact spec).  The
+  *degradation* story rides Metrics/"degraded", /healthz (503), and
+  the counters (holes_failed, device_hangs, breaker_trips, ...).
+* ``RC_FATAL`` (1) — a designed, clean operational refusal or failure:
+  invalid input stream, unwritable output/trace path, refused journal
+  resume handled by recompute, refused merge (dead/mixed shards), bad
+  flags, a shepherd rank exhausting its restart budget.
+* ``RC_FAILED_HOLES`` (2) — the --max-failed-holes budget was
+  exceeded: too many holes quarantined for the output to be worth
+  emitting as a "success" (the near-empty-FASTA-at-rc-0 trap).
+* ``RC_INJECTED_KILL`` (57) — a fault-injection hard exit
+  (utils/faultinject.py write/journal/rank_death points, os._exit);
+  distinctive so tests and operators can tell an injected kill from a
+  real crash.  Mirrors faultinject.EXIT_CODE.
+"""
+
+from ccsx_tpu.utils.faultinject import EXIT_CODE as RC_INJECTED_KILL
+
+RC_OK = 0
+RC_FATAL = 1
+RC_FAILED_HOLES = 2
+
+__all__ = ["RC_OK", "RC_FATAL", "RC_FAILED_HOLES", "RC_INJECTED_KILL"]

@@ -56,10 +56,12 @@ from ccsx_tpu.ops import banded
 from ccsx_tpu.ops import encode as enc
 from ccsx_tpu.ops import traceback
 from ccsx_tpu.pipeline import pack as pack_mod
+from ccsx_tpu.pipeline import resilience as resil_mod
 from ccsx_tpu.utils import faultinject
 from ccsx_tpu.utils import trace
 from ccsx_tpu.utils.journal import Journal
-from ccsx_tpu.utils.metrics import Metrics
+from ccsx_tpu.utils.metrics import (FailureBudgetExceeded, Metrics,
+                                    check_failure_budget)
 
 
 # ---- failure taxonomy (the fault-tolerance layer's classification of
@@ -84,9 +86,15 @@ _DATA_EXC_TYPES = (ValueError, TypeError, KeyError, IndexError,
 
 
 def classify_failure(exc: BaseException) -> str:
-    """'oom' | 'compile' | 'data' for an exception from a device dispatch.
+    """'hang' | 'oom' | 'compile' | 'data' for an exception from a
+    device dispatch.
 
-    String-matched on the message (+ exception type name): XLA surfaces
+    'hang' (DeviceHang class) is a dispatch deadline expiry
+    (resilience.DeadlineExpired): the call was ABANDONED, so there is
+    nothing to retry — re-dispatching onto a wedged backend would burn
+    another deadline — and the group goes straight down the host-replay
+    rung (and strikes the circuit breaker).  The rest are string-matched
+    on the message (+ exception type name): XLA surfaces
     both allocator exhaustion and compiler failures as XlaRuntimeError
     subclasses whose types differ across jaxlib versions, but whose
     status-code prefixes (RESOURCE_EXHAUSTED, ...) are stable.  'oom'
@@ -94,6 +102,8 @@ def classify_failure(exc: BaseException) -> str:
     (resplit / scan fallback / host replay); 'data' means the inputs or
     our own code are at fault — replayed per-hole on the host path so
     the blast radius is one quarantined hole, never the run."""
+    if isinstance(exc, resil_mod.DeadlineExpired):
+        return "hang"
     msg = f"{type(exc).__name__}: {exc}".upper()
     if any(m in msg for m in _OOM_MARKERS):
         return "oom"
@@ -134,14 +144,42 @@ def _out_shape_tag(out):
         return None
 
 
+def _bounded(resil, label_str, phase, fn):
+    """Deadline-bound ``fn`` through the run's Resilience object (a
+    plain call when deadlines are off / no resilience is wired)."""
+    if resil is None or not resil.enabled:
+        return fn()
+    return resil.call(fn, label_str, phase)
+
+
+def _host_replay_all(idxs, key, host_one, results, metrics, label,
+                     reason) -> None:
+    """The ladder bottom (and the breaker's open-state route): replay each
+    request on the bit-exact host path; a host failure becomes that
+    request's result (an Exception the driver quarantines per hole)."""
+    for i in idxs:
+        if metrics is not None:
+            metrics.bump(host_fallbacks=1)
+        try:
+            with trace.span("host_replay", cat="recover",
+                            group=label(key), reason=reason):
+                results[i] = host_one(i)
+        except Exception as he:  # quarantined per hole by the driver
+            results[i] = he
+
+
 def _run_group_sync(idxs, key, dispatch, finish, host_one, results,
                     metrics, depth, max_resplits, backoff_s,
-                    compile_retried=False, label=str) -> None:
+                    compile_retried=False, label=str, resil=None,
+                    probe=False) -> None:
     """Dispatch+materialize one (sub)group synchronously, recovering
     from failures (used on the resplit/retry paths, where the happy
-    path's dispatch-all-then-materialize overlap no longer applies)."""
+    path's dispatch-all-then-materialize overlap no longer applies).
+    ``probe``: this episode carries the breaker's half-open probe
+    token — its success/failure (and only its) settles the probe."""
     try:
-        out = dispatch(idxs, key)
+        out = _bounded(resil, label(key), "dispatch",
+                       lambda: dispatch(idxs, key))
         # same watchdog coverage as the happy path: on an async runtime
         # a hang in a RETRIED dispatch would otherwise surface inside
         # finish()'s materialization, invisible to the stall watchdog —
@@ -149,23 +187,34 @@ def _run_group_sync(idxs, key, dispatch, finish, host_one, results,
         with trace.device_span("materialize", group=label(key),
                                shape=_out_shape_tag(out),
                                attribute=False, n=len(idxs)):
-            out = jax.block_until_ready(out)
+            out = _bounded(resil, label(key), "materialize",
+                           lambda: jax.block_until_ready(out))
         finish(idxs, key, out)
+        if probe and resil is not None:
+            resil.breaker.probe_succeeded()
     except Exception as e:
         _recover_group(e, idxs, key, dispatch, finish, host_one, results,
                        metrics, depth, max_resplits, backoff_s,
-                       compile_retried, label=label)
+                       compile_retried, label=label, resil=resil,
+                       probe=probe)
 
 
 def _recover_group(exc, idxs, key, dispatch, finish, host_one, results,
                    metrics, depth, max_resplits, backoff_s,
-                   compile_retried=False, label=str) -> None:
+                   compile_retried=False, label=str, resil=None,
+                   probe=False) -> None:
     """The adaptive-retry ladder for one failed shape group.
 
+    hang    -> (DeviceHang: the dispatch deadline abandoned a wedged
+               call) no retry — the backend just proved it can wedge —
+               straight to the host replay below; books device_hangs +
+               the degraded mark and strikes the circuit breaker
     oom     -> bisect idxs (halves run at half the Z/N bucket), with
-               exponential backoff and capped depth
+               exponential backoff and capped depth; the ladder BOTTOM
+               (no more halving) strikes the breaker
     compile -> pin the banded fill to the scan spec (one-time per
-               process) and retry THIS group once.  The once-per-group
+               process), strike the breaker, and retry THIS group once.
+               The once-per-group
                retry is tracked separately from the once-per-process
                pin: in a dispatch-all sweep every group may have failed
                BEFORE the first recovery pinned the scan, and each
@@ -173,21 +222,27 @@ def _recover_group(exc, idxs, key, dispatch, finish, host_one, results,
                slower per-request host replay
     data / ladder bottom -> replay each request on the host path;
                a host failure becomes that request's result (an
-               Exception the driver quarantines per hole)
+               Exception the driver quarantines per hole).  'data'
+               never strikes the breaker: a bad hole says nothing
+               about backend health
     """
     kind = classify_failure(exc)
     trace.instant("recover", cat="recover", kind=kind, group=label(key),
                   n=len(idxs), depth=depth)
+    if kind == "hang" and resil is not None:
+        resil.note_hang(label(key), exc, probe=probe)
     if kind == "compile" and not compile_retried:
         from ccsx_tpu.consensus import star as star_mod
 
+        if resil is not None:
+            resil.breaker.strike("compile", label(key), probe=probe)
         if star_mod.force_scan_fallback(f"{type(exc).__name__}: {exc}") \
                 and metrics is not None:
             metrics.bump(compile_fallbacks=1)
         return _run_group_sync(idxs, key, dispatch, finish, host_one,
                                results, metrics, depth, max_resplits,
                                backoff_s, compile_retried=True,
-                               label=label)
+                               label=label, resil=resil, probe=probe)
     if kind == "oom" and depth < max_resplits and len(idxs) > 1:
         if metrics is not None:
             metrics.bump(oom_resplits=1)
@@ -199,25 +254,28 @@ def _recover_group(exc, idxs, key, dispatch, finish, host_one, results,
         for part in (idxs[:mid], idxs[mid:]):
             _run_group_sync(part, key, dispatch, finish, host_one,
                             results, metrics, depth + 1, max_resplits,
-                            backoff_s, compile_retried, label=label)
+                            backoff_s, compile_retried, label=label,
+                            resil=resil, probe=probe)
         return
+    if kind == "oom" and resil is not None:
+        # the OOM ladder bottomed out (depth cap or single request):
+        # that is a backend-health strike, unlike a recoverable resplit
+        resil.breaker.strike("oom", label(key), probe=probe)
+    if kind == "data" and resil is not None and probe:
+        # a per-hole data error never strikes — but THE probe's token
+        # must still be released or the breaker wedges half-open
+        # forever (admit() refuses all dispatch while a probe is
+        # outstanding); non-probe data failures leave the probe alone
+        resil.breaker.settle_probe()
     print(f"[ccsx-tpu] device dispatch failed ({kind}) for a "
           f"{len(idxs)}-request group {key}; replaying on the host "
           f"path: {exc}", file=sys.stderr)
-    for i in idxs:
-        if metrics is not None:
-            metrics.bump(host_fallbacks=1)
-        try:
-            with trace.span("host_replay", cat="recover",
-                            group=label(key), reason=kind):
-                results[i] = host_one(i)
-        except Exception as he:  # quarantined per hole by the driver
-            results[i] = he
+    _host_replay_all(idxs, key, host_one, results, metrics, label, kind)
 
 
 def _run_groups_recovering(groups, dispatch, finish, host_one, results,
                            metrics, max_resplits=3,
-                           backoff_s=0.05, label=str) -> None:
+                           backoff_s=0.05, label=str, resil=None) -> None:
     """Happy path: dispatch every group's device work before
     materializing any result (jit dispatch is async, so group B's
     compute overlaps group A's d2h transfer); failures at either
@@ -226,14 +284,35 @@ def _run_groups_recovering(groups, dispatch, finish, host_one, results,
     spans use (e.g. dropping the packed path's per-slab ordinal), so
     materialize spans share the dispatch namespace and the watchdog's
     per-(group, shape) compile grace neither re-arms on every slab nor
-    misses a fresh shape's cold compile."""
+    misses a fresh shape's cold compile.
+
+    Resilience (pipeline/resilience.py, ``resil``): an OPEN circuit
+    breaker routes whole groups to the host path without touching the
+    device (one probe group per --breaker-probe-s interval when
+    half-open); a configured --dispatch-deadline bounds both the
+    dispatch call and the materialize wait, abandoning wedged calls
+    into the ladder's ``hang`` class."""
+    _OPEN = object()   # sentinel: breaker refused this group's dispatch
     pending = []
     for key, idxs in groups.items():
+        mode = resil.admit() if resil is not None else "closed"
+        if mode == "host":
+            pending.append((idxs, key, _OPEN, None, False))
+            continue
+        probe = mode == "probe"
         try:
-            pending.append((idxs, key, None, dispatch(idxs, key)))
+            out = _bounded(resil, label(key), "dispatch",
+                           lambda k=key, i=idxs: dispatch(i, k))
+            pending.append((idxs, key, None, out, probe))
         except Exception as e:
-            pending.append((idxs, key, e, None))
-    for idxs, key, exc, out in pending:
+            pending.append((idxs, key, e, None, probe))
+    for idxs, key, exc, out, probe in pending:
+        if exc is _OPEN:
+            trace.instant("recover", cat="recover", kind="breaker_open",
+                          group=label(key), n=len(idxs))
+            _host_replay_all(idxs, key, host_one, results, metrics,
+                             label, "breaker_open")
+            continue
         try:
             if exc is not None:
                 raise exc
@@ -250,12 +329,18 @@ def _run_groups_recovering(groups, dispatch, finish, host_one, results,
             with trace.device_span("materialize", group=label(key),
                                    shape=_out_shape_tag(out),
                                    attribute=False, n=len(idxs)):
-                out = jax.block_until_ready(out)
+                out = _bounded(resil, label(key), "materialize",
+                               lambda o=out: jax.block_until_ready(o))
             finish(idxs, key, out)
+            # only THE probe's own completion settles the breaker — a
+            # concurrent pre-trip group finishing must not close it on
+            # stale evidence (the admit() token carries the identity)
+            if probe and resil is not None:
+                resil.breaker.probe_succeeded()
         except Exception as e:
             _recover_group(e, idxs, key, dispatch, finish, host_one,
                            results, metrics, 0, max_resplits, backoff_s,
-                           label=label)
+                           label=label, resil=resil, probe=probe)
 
 
 @functools.lru_cache(maxsize=128)
@@ -901,10 +986,14 @@ class PairExecutor:
     seed_cache_max = 128
 
     def __init__(self, params: AlignParams, quant: int = 512,
-                 metrics=None, warmup=None):
+                 metrics=None, warmup=None, resil=None):
         self.params = params
         self.quant = quant
         self.metrics = metrics
+        # shared Resilience object (pipeline/resilience.py): pair fills
+        # ride the same dispatch deadline + circuit breaker as the
+        # refine dispatches — a wedged chip wedges both
+        self._resil = resil
         self._warmup = warmup      # AOT precompiler (pipeline/warmup.py)
         self._warmed: set = set()  # inline-warm dedupe (no compiler)
         self._host_aligner = None  # built lazily, on first fallback
@@ -1047,6 +1136,7 @@ class PairExecutor:
                     cells=N * qmax * self.params.band,
                     shape=f"N{N}", n=len(idxs)) as sp:
                 faultinject.fire("stall")
+                faultinject.fire("device_hang")
                 return sp.force(step(big, small))
 
         def finish(idxs, key, res):
@@ -1074,7 +1164,8 @@ class PairExecutor:
 
         _run_groups_recovering(groups, dispatch, finish, host_one,
                                results, self.metrics,
-                               label=lambda k: f"pair:q{k[0]}:t{k[1]}")
+                               label=lambda k: f"pair:q{k[0]}:t{k[1]}",
+                               resil=self._resil)
         return results
 
 
@@ -1105,10 +1196,13 @@ class BatchExecutor:
     oom_backoff_s = 0.05
 
     def __init__(self, cfg: CcsConfig, metrics=None, warmup=None,
-                 devices=None):
+                 devices=None, resil=None):
         self.cfg = cfg
         self.len_quant = cfg.len_bucket_quant
         self.metrics = metrics
+        # shared Resilience object (pipeline/resilience.py): dispatch
+        # deadline + backend circuit breaker; None = legacy callers
+        self._resil = resil
         # AOT warmup precompiler (pipeline/warmup.py), shared with the
         # driver's PairExecutor; None = --no-warmup / legacy callers
         self._warmup = warmup
@@ -1555,7 +1649,7 @@ class BatchExecutor:
         _run_groups_recovering(groups, dispatch, finish, host_one,
                                results, self.metrics,
                                self.max_oom_resplits, self.oom_backoff_s,
-                               label=label)
+                               label=label, resil=self._resil)
 
     def _run_rounds(self, requests: List[RoundRequest]) -> List[RoundResult]:
         cfg = self.cfg
@@ -1581,6 +1675,7 @@ class BatchExecutor:
                     cells=Z * P * qmax * cfg.align.band,
                     shape=f"Z{Z}", n=len(idxs), Z=Z) as sp:
                 faultinject.fire("stall")
+                faultinject.fire("device_hang")
                 if self._mesh is None:
                     # packed single-device transfers, as in _run_refine
                     step = _round_step(cfg.align, cfg.max_ins_per_col,
@@ -1651,6 +1746,7 @@ class BatchExecutor:
                     cells=Z * P * qmax * cfg.align.band * iters,
                     shape=f"Z{Z}", n=len(idxs), Z=Z) as sp:
                 faultinject.fire("stall")
+                faultinject.fire("device_hang")
                 if self._mesh is None:
                     # single device: packed transfer protocol (2 h2d +
                     # 2 d2h latencies per dispatch instead of 5 + 9)
@@ -1820,6 +1916,7 @@ class BatchExecutor:
                         plan={"slab": key[3], "rows": R,
                               "holes": len(idxs)}) as sp:
                     faultinject.fire("stall")
+                    faultinject.fire("device_hang")
                     return sp.force(step(big, small))
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as PS
@@ -1855,6 +1952,7 @@ class BatchExecutor:
                           "chips": D, "rows": R,
                           "holes": len(idxs)}) as sp:
                 faultinject.fire("stall")
+                faultinject.fire("device_hang")
                 big = jax.device_put(bigs, sharding)
                 small = jax.device_put(smalls, sharding)
                 return sp.force(step(big, small))
@@ -2018,14 +2116,25 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
         from ccsx_tpu.pipeline.warmup import WarmupCompiler
 
         warm = WarmupCompiler()
-    executor = BatchExecutor(cfg, metrics=metrics, warmup=warm)
+    # resilient execution (pipeline/resilience.py): one dispatch-
+    # deadline runner + circuit breaker shared by BOTH executors, so
+    # pair-fill and refine failures count against the same backend
+    resil = resil_mod.Resilience(cfg, metrics=metrics)
+    executor = BatchExecutor(cfg, metrics=metrics, warmup=warm,
+                             resil=resil)
     pair_executor = PairExecutor(cfg.align, quant=cfg.len_bucket_quant,
-                                 metrics=metrics, warmup=warm)
+                                 metrics=metrics, warmup=warm,
+                                 resil=resil)
 
     def warm_hole(h) -> None:
         if warm is not None and isinstance(h.req, RefineRequest):
             executor.warm_refine(h.req, hole_id=h.idx)
     resume = journal.holes_done
+    # restore the journaled failure count: a --max-failed-holes budget
+    # is judged over the whole logical run, resumes included (journaled
+    # failures are skipped as done and would otherwise never re-count)
+    metrics.holes_failed = journal.holes_failed
+    metrics.holes_prior_emitted = journal.holes_emitted
     put_at = getattr(writer, "put_at", None)
 
     active: List[_Hole] = []
@@ -2050,6 +2159,10 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
                 metrics.holes_failed += 1
                 print(f"[ccsx-tpu] hole {h.zmw.movie}/{h.zmw.hole} "
                       f"failed: {h.err}", file=sys.stderr)
+                # failure-rate abort (--max-failed-holes): quarantine
+                # is no longer unbounded — a count budget aborts here,
+                # a fraction budget at end of run (metrics.py)
+                check_failure_budget(metrics, cfg)
             elif h.cns is not None and h.cns[0]:
                 name = f"{h.zmw.movie}/{h.zmw.hole}/ccs"
                 seq, qual = h.cns
@@ -2064,6 +2177,12 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
             # flush-before-cursor + write fault point + advance: the
             # shared crash invariant lives in Journal.retire
             journal.retire(writer, wrote, metrics)
+            # rank_death models a sharded rank SIGKILLed mid-run (the
+            # shepherd's restart-and-resume acceptance case): fired at
+            # a retirement point so the dead rank leaves a valid
+            # journal + durable records behind, exactly like a real
+            # OOM-kill between holes
+            faultinject.fire("rank_death")
             metrics.tick()
             next_emit += 1
             if pool is not None:
@@ -2236,6 +2355,15 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
             # interval-driven progress events even while nothing has
             # retired yet (a holes<=inflight run drains at the very end)
             metrics.heartbeat()
+        # fraction-form --max-failed-holes settles at end of run, when
+        # the processed-hole denominator is final (metrics.py)
+        check_failure_budget(metrics, cfg, final=True)
+    except FailureBudgetExceeded as e:
+        from ccsx_tpu import exitcodes
+
+        print(f"Error: {e}; aborting instead of emitting a degraded "
+              "output at rc 0", file=sys.stderr)
+        rc = exitcodes.RC_FAILED_HOLES
     except (bam_mod.BamError, zmw_mod.InvalidZmwName, ValueError) as e:
         print(f"Error: invalid input stream: {e}", file=sys.stderr)
         rc = 1

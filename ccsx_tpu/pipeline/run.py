@@ -24,7 +24,8 @@ from ccsx_tpu.utils import faultinject
 from ccsx_tpu.utils import trace
 from ccsx_tpu.utils.device import resolve_device
 from ccsx_tpu.utils.journal import Journal
-from ccsx_tpu.utils.metrics import Metrics
+from ccsx_tpu.utils.metrics import (FailureBudgetExceeded, Metrics,
+                                    check_failure_budget)
 
 
 def open_zmw_stream(path: str, cfg: CcsConfig, metrics=None):
@@ -149,6 +150,10 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
     # (truncate torn / refuse untrustworthy) before the writer opens
     journal = Journal.for_run(journal_path, in_path, cfg, out_path)
     resume = journal.holes_done
+    # restore the journaled failure count so --max-failed-holes is
+    # judged over the whole logical run, resumes included
+    metrics.holes_failed = journal.holes_failed
+    metrics.holes_prior_emitted = journal.holes_emitted
     try:
         writer = open_writer(out_path, append=bool(resume),
                              bam=cfg.bam_out,
@@ -188,6 +193,10 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
                 metrics.holes_failed += 1
                 print(f"[ccsx-tpu] hole {z.movie}/{z.hole} failed: {err}",
                       file=sys.stderr)
+                # failure-rate abort (--max-failed-holes): a count
+                # budget aborts immediately, a fraction budget settles
+                # at end of run (utils/metrics.py)
+                check_failure_budget(metrics, cfg)
             elif rec is not None and rec[0]:
                 writer.put(f"{z.movie}/{z.hole}/ccs", rec[0], rec[1])
                 metrics.holes_out += 1
@@ -254,6 +263,14 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
             with metrics.timer("compute"):
                 item = pending.popleft().result()
             write_result(item)
+        # fraction-form --max-failed-holes settles at end of run
+        check_failure_budget(metrics, cfg, final=True)
+    except FailureBudgetExceeded as e:
+        from ccsx_tpu import exitcodes
+
+        print(f"Error: {e}; aborting instead of emitting a degraded "
+              "output at rc 0", file=sys.stderr)
+        rc = exitcodes.RC_FAILED_HOLES
     except (bam_mod.BamError, zmw.InvalidZmwName, ValueError) as e:
         print(f"Error: invalid input stream: {e}", file=sys.stderr)
         rc = 1

@@ -1,0 +1,438 @@
+"""`ccsx-tpu shepherd`: a rank supervisor for sharded runs.
+
+Until now a dead rank in a sharded run was merely *visible*: the rank
+never wrote its completion marker, ``merge_shards`` refused the merge,
+and the operator was told to re-run the dead rank by hand
+(parallel/distributed.py).  The ROADMAP north star is production-scale
+serving, where "a human re-runs rank 3 at 2am" is not a failure story.
+The shepherd turns that manual instruction into a supervised loop:
+
+* **Launch** — the N ranks run as subprocesses of one supervisor
+  process (`python -c` runners invoking the ordinary CLI with
+  ``--hosts N --host-id r``), each with a per-rank log file
+  (``<out>.shard<r>.log``) and — unless the caller provided one — a
+  shepherd-owned journal (``<out>.shepherd.journal``; the sharded
+  driver suffixes ``.shard<r>``), because the journal is what makes a
+  restart a RESUME instead of a recompute.
+
+* **Monitor** — liveness is the rank's *progress heartbeat*: the
+  newest mtime across its shard journal, shard output, and ordinal
+  sidecar (the journal is fsynced at least once a second while holes
+  retire).  With ``--telemetry-port`` the per-rank ``/healthz``
+  endpoints (base port + rank, parallel/distributed.py) are polled too
+  — a 503/degraded rank is reported in the shepherd log; an
+  *unreachable* endpoint is only informational (the process poll is
+  the authority on death).  A rank whose heartbeat goes stale past
+  ``--rank-stall-timeout`` (0 = disabled; size it above your worst
+  cold-compile time, or serve telemetry and rely on the rank's own
+  ``--dispatch-deadline`` instead) is SIGKILLed and treated as dead.
+
+* **Restart** — a dead rank (nonzero exit, or killed as stalled) is
+  relaunched with exponential backoff (``--rank-backoff`` x 2^attempt)
+  up to ``--max-rank-restarts`` times; it resumes from its shard
+  journal, so already-durable records are never recomputed.
+  ``CCSX_FAULTS`` is stripped from restart environments — injected
+  faults model the FIRST failure, and a restarted rank must run clean
+  (the chaos harness depends on this).  A rank that exhausts its
+  restarts fails the whole run (rc 1) — the remaining ranks are still
+  driven to completion so their journals are warm for a later retry.
+
+* **Merge** — when every rank has exited 0 (completion markers in
+  place), the shepherd runs the ordinary ``merge_shards`` and exits 0.
+  Output is byte-identical to an unsharded run by the existing merge
+  invariants, restarts included (pinned by tests/test_supervisor.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from ccsx_tpu import exitcodes
+
+# the subprocess runner body; a PRELUDE (backend pinning for tests /
+# CPU-forced environments) may be prepended
+_RUNNER = ("import sys; from ccsx_tpu.cli import main; "
+           "sys.exit(main(sys.argv[1:]))")
+
+# shepherd-only flags stripped from the forwarded rank command line
+_SHEPHERD_FLAGS = ("--max-rank-restarts", "--rank-backoff",
+                   "--rank-stall-timeout")
+
+
+def default_prelude() -> str:
+    """Backend pinning for the rank runners: when this process is
+    itself forced onto CPU (JAX_PLATFORMS=cpu — the test suite, `make
+    chaos`, CI), the ranks must be too; some accelerator plugins
+    override the env var at import time, so the pin must be an explicit
+    jax.config call before the CLI imports (the same idiom as
+    tests/test_faults._run_cli_subprocess)."""
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return ("import jax; "
+                "jax.config.update('jax_platforms', 'cpu'); ")
+    return ""
+
+
+def strip_shepherd_flags(argv: List[str],
+                         flags=_SHEPHERD_FLAGS) -> List[str]:
+    """Remove shepherd-only options (+ their values) from an argv so
+    the remainder forwards verbatim to the rank command lines."""
+    out: List[str] = []
+    skip = False
+    for a in argv:
+        if skip:
+            skip = False
+            continue
+        if a in flags:
+            skip = True
+            continue
+        if any(a.startswith(f + "=") for f in flags):
+            continue
+        out.append(a)
+    return out
+
+
+@dataclasses.dataclass
+class _Rank:
+    rank: int
+    proc: Optional[subprocess.Popen] = None
+    log: Optional[object] = None
+    attempts: int = 0          # restarts used (0 = first launch)
+    beat: float = 0.0          # monotonic time of last progress sign
+    last_mtime: Optional[float] = None  # newest observed shard mtime
+    relaunch_at: Optional[float] = None
+    done: bool = False
+    failed: Optional[str] = None
+    failed_rc: Optional[int] = None
+    last_health: Optional[str] = None
+
+
+def _beat_paths(out_path: str, journal: str, rank: int) -> List[str]:
+    return [f"{journal}.shard{rank}",
+            f"{out_path}.shard{rank}",
+            f"{out_path}.shard{rank}.idx"]
+
+
+def _latest_mtime(paths: List[str]) -> Optional[float]:
+    best = None
+    for p in paths:
+        try:
+            m = os.stat(p).st_mtime
+        except OSError:
+            continue
+        best = m if best is None or m > best else best
+    return best
+
+
+def _poll_healthz(port: int, timeout: float = 0.5) -> Optional[str]:
+    """'ok' | 'degraded' | None (unreachable).  Best effort only — the
+    endpoint auto-bumps when its port is taken, so unreachable is
+    informational, never a death verdict."""
+    import urllib.error
+    import urllib.request
+
+    url = f"http://127.0.0.1:{port}/healthz"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read().decode()).get("status", "ok")
+    except urllib.error.HTTPError as e:  # 503 carries the body
+        try:
+            return json.loads(e.read().decode()).get("status",
+                                                     "degraded")
+        except (ValueError, OSError):
+            return "degraded"
+    except (OSError, ValueError):
+        return None
+
+
+def shepherd_run(in_path: str, out_path: str, hosts: int,
+                 forward_args: List[str],
+                 journal: Optional[str] = None,
+                 max_restarts: int = 2,
+                 backoff_s: float = 1.0,
+                 rank_stall_timeout: float = 0.0,
+                 telemetry_port: int = 0,
+                 env: Optional[dict] = None,
+                 first_launch_env: Optional[Dict[int, dict]] = None,
+                 poll_s: float = 0.25,
+                 merge: bool = True,
+                 runner_prelude: Optional[str] = None) -> int:
+    """Supervise a sharded run end to end; returns a process rc
+    (exitcodes.py: 0 = merged, 1 = a rank exhausted its restarts or
+    the merge was refused).
+
+    ``forward_args`` is the full rank CLI argv (flags + INPUT OUTPUT,
+    including ``--hosts``) WITHOUT ``--host-id`` — the shepherd
+    appends it per rank.  ``first_launch_env`` maps rank -> extra env
+    for attempt 0 only (the fault-injection hook: restarts run clean).
+    """
+    from ccsx_tpu.parallel.distributed import merge_shards
+
+    if hosts < 1:
+        print("Error: shepherd needs --hosts >= 1", file=sys.stderr)
+        return exitcodes.RC_FATAL
+    base_env = dict(os.environ if env is None else env)
+    prelude = (default_prelude() if runner_prelude is None
+               else runner_prelude)
+    first_launch_env = first_launch_env or {}
+    # a journal is what makes a restart a resume; inject one when the
+    # caller didn't ask for their own
+    fwd = list(forward_args)
+    if journal is None and "--journal" not in fwd:
+        journal = f"{out_path}.shepherd.journal"
+        fwd += ["--journal", journal]
+    elif journal is None:
+        journal = fwd[fwd.index("--journal") + 1]
+
+    def launch(st: _Rank) -> None:
+        e = dict(base_env)
+        rank_fwd = fwd
+        if st.attempts == 0:
+            e.update(first_launch_env.get(st.rank, {}))
+        else:
+            # restarts run clean: injected faults model the FIRST
+            # failure (a re-armed rank_death would die forever) — both
+            # the env form AND the forwarded CLI flag
+            e.pop("CCSX_FAULTS", None)
+            rank_fwd = strip_shepherd_flags(fwd,
+                                            flags=("--inject-faults",))
+        cmd = [sys.executable, "-c", prelude + _RUNNER, *rank_fwd,
+               "--host-id", str(st.rank)]
+        log_path = f"{out_path}.shard{st.rank}.log"
+        try:
+            st.log = open(log_path, "a", encoding="utf-8")
+            st.log.write(f"\n=== shepherd launch rank {st.rank} attempt "
+                         f"{st.attempts} @ {time.strftime('%H:%M:%S')} "
+                         f"===\n")
+            st.log.flush()
+            sink = st.log
+        except OSError as e_log:
+            # an unwritable log (e.g. the output dir itself is the
+            # problem) must not crash the supervisor — the rank will
+            # fail with the real error on its own
+            print(f"[ccsx-tpu] shepherd: cannot open {log_path} "
+                  f"({e_log}); rank {st.rank} output discarded",
+                  file=sys.stderr)
+            st.log = None
+            sink = subprocess.DEVNULL
+        st.proc = subprocess.Popen(cmd, env=e, stdout=sink,
+                                   stderr=subprocess.STDOUT)
+        st.beat = time.monotonic()
+        st.relaunch_at = None
+        print(f"[ccsx-tpu] shepherd: rank {st.rank} up (pid "
+              f"{st.proc.pid}, attempt {st.attempts}, log {log_path})",
+              file=sys.stderr)
+
+    def close_log(st: _Rank) -> None:
+        if st.log is not None:
+            try:
+                st.log.close()
+            except OSError:
+                pass
+            st.log = None
+
+    def schedule_restart(st: _Rank, reason: str) -> None:
+        close_log(st)
+        st.proc = None
+        if st.attempts >= max_restarts:
+            st.failed = (f"rank {st.rank} {reason} and exhausted its "
+                         f"{max_restarts} restart(s)")
+            st.done = True
+            print(f"[ccsx-tpu] shepherd: {st.failed}", file=sys.stderr)
+            return
+        st.attempts += 1
+        delay = backoff_s * (2 ** (st.attempts - 1))
+        st.relaunch_at = time.monotonic() + delay
+        print(f"[ccsx-tpu] shepherd: rank {st.rank} {reason}; "
+              f"restarting in {delay:g}s (attempt {st.attempts}/"
+              f"{max_restarts}; resumes from its shard journal)",
+              file=sys.stderr)
+
+    ranks = [_Rank(rank=r) for r in range(hosts)]
+    for st in ranks:
+        launch(st)
+    last_health_poll = 0.0
+    try:
+        while not all(st.done for st in ranks):
+            now = time.monotonic()
+            poll_health = (telemetry_port
+                           and now - last_health_poll >= 2.0)
+            if poll_health:
+                last_health_poll = now
+            for st in ranks:
+                if st.done:
+                    continue
+                if st.proc is None:
+                    if st.relaunch_at is not None and now >= st.relaunch_at:
+                        launch(st)
+                    continue
+                rc = st.proc.poll()
+                if rc is not None:
+                    if rc == 0:
+                        st.done = True
+                        close_log(st)
+                        print(f"[ccsx-tpu] shepherd: rank {st.rank} "
+                              "completed", file=sys.stderr)
+                    elif rc == exitcodes.RC_FAILED_HOLES:
+                        # a failed-hole budget abort is DETERMINISTIC:
+                        # the journal carries the failure count across
+                        # resumes, so a restart would re-abort — fail
+                        # the rank immediately instead of burning the
+                        # restart budget on it
+                        close_log(st)
+                        st.proc = None
+                        st.failed = (f"rank {st.rank} exceeded its "
+                                     "--max-failed-holes budget (rc "
+                                     f"{rc}); not restartable")
+                        st.failed_rc = rc
+                        st.done = True
+                        print(f"[ccsx-tpu] shepherd: {st.failed}",
+                              file=sys.stderr)
+                    else:
+                        schedule_restart(st, f"died (rc {rc})")
+                    continue
+                # progress heartbeat: journal/shard mtimes (fsynced at
+                # least once a second while holes retire).  A CHANGED
+                # mtime stamps the beat on OUR monotonic clock —
+                # comparing wall-clock mtimes against monotonic time
+                # would let an NTP step mark every healthy rank stale
+                m = _latest_mtime(_beat_paths(out_path, journal,
+                                              st.rank))
+                if m is not None and m != st.last_mtime:
+                    st.last_mtime = m
+                    st.beat = now
+                if poll_health:
+                    h = _poll_healthz(telemetry_port + st.rank)
+                    if h != st.last_health and h is not None:
+                        st.last_health = h
+                        if h != "ok":
+                            print(f"[ccsx-tpu] shepherd: rank "
+                                  f"{st.rank} /healthz reports {h}",
+                                  file=sys.stderr)
+                if (rank_stall_timeout > 0
+                        and now - st.beat > rank_stall_timeout):
+                    print(f"[ccsx-tpu] shepherd: rank {st.rank} "
+                          f"heartbeat stale for >{rank_stall_timeout:g}s"
+                          " — killing the wedged rank", file=sys.stderr)
+                    try:
+                        st.proc.send_signal(signal.SIGKILL)
+                        st.proc.wait(timeout=10.0)
+                    except (OSError, subprocess.TimeoutExpired):
+                        pass
+                    schedule_restart(st, "stalled")
+            time.sleep(poll_s)
+    finally:
+        for st in ranks:
+            if st.proc is not None and st.proc.poll() is None:
+                st.proc.kill()
+                try:
+                    st.proc.wait(timeout=10.0)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+            close_log(st)
+    failed = [st for st in ranks if st.failed]
+    if failed:
+        print("Error: shepherd run failed: "
+              + "; ".join(st.failed for st in failed)
+              + " — surviving ranks completed and their journals are "
+              "intact; fix the cause and re-run the shepherd to resume",
+              file=sys.stderr)
+        # preserve the exit-code taxonomy through supervision: when
+        # every failure is the deterministic failed-hole budget abort,
+        # the shepherd reports rc 2 like an unsharded run would; any
+        # other failure class stays the generic rc 1
+        rcs = {st.failed_rc for st in failed}
+        if rcs == {exitcodes.RC_FAILED_HOLES}:
+            return exitcodes.RC_FAILED_HOLES
+        return exitcodes.RC_FATAL
+    if not merge:
+        return exitcodes.RC_OK
+    try:
+        n = merge_shards(out_path, hosts)
+    except (OSError, ValueError) as e:
+        print(f"Error: shepherd merge refused: {e}", file=sys.stderr)
+        return exitcodes.RC_FATAL
+    print(f"[ccsx-tpu] shepherd: merged {n} records from {hosts} "
+          "ranks", file=sys.stderr)
+    return exitcodes.RC_OK
+
+
+def shepherd_main(argv) -> int:
+    """The `ccsx-tpu shepherd` subcommand (dispatched from cli.main):
+    the ordinary CLI grammar plus the supervisor knobs; everything
+    except the shepherd-only flags forwards verbatim to the ranks."""
+    from ccsx_tpu import cli as cli_mod
+
+    p = cli_mod.build_parser()
+    p.prog = "ccsx-tpu shepherd"
+    p.add_argument("--max-rank-restarts", type=int, default=2,
+                   dest="max_rank_restarts", metavar="N",
+                   help="restarts allowed per rank before the run "
+                        "fails [2]")
+    p.add_argument("--rank-backoff", type=float, default=1.0,
+                   dest="rank_backoff", metavar="SEC",
+                   help="restart backoff base (doubles per attempt) "
+                        "[1.0]")
+    p.add_argument("--rank-stall-timeout", type=float, default=0.0,
+                   dest="rank_stall_timeout", metavar="SEC",
+                   help="SIGKILL + restart a rank whose progress "
+                        "heartbeat (shard journal/output mtimes) goes "
+                        "stale this long; 0 disables — size it above "
+                        "your worst cold compile, or prefer the "
+                        "rank-level --dispatch-deadline [0]")
+    args = p.parse_args(argv)
+    if args.help:
+        return cli_mod.usage()
+    if args.hosts is None or args.hosts < 1:
+        print("Error: shepherd requires --hosts N (>= 1)",
+              file=sys.stderr)
+        return exitcodes.RC_FATAL
+    if args.host_id is not None:
+        print("Error: shepherd owns --host-id; do not pass it",
+              file=sys.stderr)
+        return exitcodes.RC_FATAL
+    if args.merge_shards is not None or args.make_index:
+        print("Error: shepherd cannot combine with --merge-shards/"
+              "--make-index", file=sys.stderr)
+        return exitcodes.RC_FATAL
+    if args.bam_out:
+        print("Error: --bam is not supported with --hosts "
+              "(use --fastq and convert the merged output)",
+              file=sys.stderr)
+        return exitcodes.RC_FATAL
+    if args.batch == "off":
+        # refused up front: each rank would refuse it anyway, and the
+        # shepherd would burn its restart budget on a config error
+        print("Error: --batch off is not supported with --hosts",
+              file=sys.stderr)
+        return exitcodes.RC_FATAL
+    if args.input == "-" or args.output == "-":
+        print("Error: shepherd needs real INPUT/OUTPUT paths (ranks "
+              "re-read the input; shards merge into the output)",
+              file=sys.stderr)
+        return exitcodes.RC_FATAL
+    # validate the shared config once up front (same errors the ranks
+    # would produce N times over)
+    try:
+        cli_mod.config_from_args(args)
+    except SystemExit as e:
+        return int(e.code or 0)
+    forward = strip_shepherd_flags(list(argv))
+    return shepherd_run(
+        args.input, args.output, args.hosts, forward,
+        journal=args.journal,
+        max_restarts=args.max_rank_restarts,
+        backoff_s=args.rank_backoff,
+        rank_stall_timeout=args.rank_stall_timeout,
+        telemetry_port=args.telemetry_port or 0)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(shepherd_main(sys.argv[1:]))

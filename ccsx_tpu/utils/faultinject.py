@@ -31,6 +31,19 @@ Points and their actions (each placed at ONE spot in the pipeline):
               deterministic hang that proves the stall watchdog
               (utils/trace.py, --stall-timeout) fires and dumps; the
               dispatch then completes normally
+  device_hang sleep CCSX_FAULT_HANG_S seconds (default 3600) inside a
+              device dispatch — a PERMANENT wedge at test scale, the
+              r5 dead-tunnel failure made deterministic.  Only the
+              dispatch deadline (--dispatch-deadline,
+              pipeline/resilience.py) rescues the run: the call is
+              abandoned and the group replays on the host path; with
+              deadlines off the run stalls exactly as r5 did (watchdog
+              dumps, never kills)
+  rank_death  hard process exit (os._exit) at a hole-retirement point
+              in the batched driver — models a sharded rank dying
+              mid-run (SIGKILL/OOM-killer), the failure the
+              `ccsx-tpu shepherd` supervisor (pipeline/supervisor.py)
+              must detect, restart, and merge through
   write       hard process exit (os._exit) after a record is written and
               flushed but BEFORE the journal advances — the torn-tail
               crash the journal v2 resume must repair
@@ -51,7 +64,8 @@ import os
 import threading
 from typing import Dict, Optional
 
-POINTS = ("ingest", "compute", "device_oom", "stall", "write", "journal")
+POINTS = ("ingest", "compute", "device_oom", "stall", "device_hang",
+          "rank_death", "write", "journal")
 
 # exit code of the write/journal crash actions — distinctive, so a test
 # (or an operator) can tell an injected kill from a real failure
@@ -151,18 +165,23 @@ def fire(point: str) -> None:
         raise RuntimeError(
             "RESOURCE_EXHAUSTED: injected device OOM "
             f"(faultinject, call {n})")
-    if point == "stall":
-        # a hang, not a failure: sleep with the dispatch span open so
-        # the stall watchdog provably fires, then proceed normally
+    if point in ("stall", "device_hang"):
+        # a hang, not a failure: sleep with the dispatch span open.
+        # `stall` is transient (the dispatch then completes — proves
+        # the watchdog fires); `device_hang` is effectively permanent
+        # (default 1 h — proves the dispatch DEADLINE abandons it; the
+        # parked thread is daemonic and dies with the process)
         import time
 
+        env, dflt = (("CCSX_FAULT_STALL_S", 1.0) if point == "stall"
+                     else ("CCSX_FAULT_HANG_S", 3600.0))
         try:
-            dur = float(os.environ.get("CCSX_FAULT_STALL_S", "1.0"))
+            dur = float(os.environ.get(env, str(dflt)))
         except ValueError:
-            dur = 1.0
+            dur = dflt
         time.sleep(max(dur, 0.0))
         return
-    # write / journal: simulated SIGKILL — flush the injection notice,
-    # then exit without running any cleanup
+    # write / journal / rank_death: simulated SIGKILL — flush the
+    # injection notice, then exit without running any cleanup
     sys.stderr.flush()
     os._exit(EXIT_CODE)

@@ -36,6 +36,14 @@ _NON_SEMANTIC = frozenset({
     "trace_path", "stall_timeout_s",
     "pass_buckets", "zmw_microbatch", "chunk_size", "chunk_growth",
     "chunk_cap",
+    # resilient execution (pipeline/resilience.py): deadlines/breaker
+    # only choose WHERE a request computes (device vs the bit-exact
+    # host spec), and the failure budget only changes the rc — none
+    # can change output bytes, and the canonical recovery move ("it
+    # hung; re-run WITH --dispatch-deadline and resume") must not be
+    # refused as a config change
+    "dispatch_deadline_s", "breaker_strikes", "breaker_window_s",
+    "breaker_probe_s", "max_failed_holes",
 })
 
 

@@ -81,6 +81,15 @@ class Journal:
     path: str
     input_id: str
     holes_done: int = 0
+    # failed (quarantined) and emitted holes among the retired ones:
+    # restored into Metrics on resume so a --max-failed-holes budget is
+    # judged over the WHOLE logical run — without the failure count,
+    # every resume would silently grant a fresh budget, and without the
+    # emitted count a fraction budget would judge prior failures
+    # against THIS session's successes only (spurious rc-2 aborts on
+    # short resume tails)
+    holes_failed: int = 0
+    holes_emitted: int = 0
     out_bytes: Optional[int] = None   # output file size at the cursor
     idx_bytes: Optional[int] = None   # shard .idx sidecar size (sharded runs)
     fingerprint: Optional[str] = None  # config/code compat key for THIS run
@@ -143,6 +152,8 @@ class Journal:
                       file=sys.stderr)
                 return j
             j.holes_done = int(d.get("holes_done", 0))
+            j.holes_failed = int(d.get("holes_failed", 0))
+            j.holes_emitted = int(d.get("holes_emitted", 0))
             ob, ib = d.get("out_bytes"), d.get("idx_bytes")
             j.out_bytes = int(ob) if ob is not None else None
             j.idx_bytes = int(ib) if ib is not None else None
@@ -151,6 +162,8 @@ class Journal:
     def reset(self) -> None:
         """Discard the resume state (the caller recomputes from scratch)."""
         self.holes_done = 0
+        self.holes_failed = 0
+        self.holes_emitted = 0
         self.out_bytes = None
         self.idx_bytes = None
 
@@ -207,6 +220,12 @@ class Journal:
                     else:
                         flush()
             faultinject.fire("write")
+        if wrote:
+            self.holes_emitted += 1
+        if metrics is not None:
+            # carried so a resume restores the failure count (the
+            # --max-failed-holes budget survives restarts)
+            self.holes_failed = metrics.holes_failed
         self.advance(out_bytes=getattr(writer, "bytes_out", None),
                      idx_bytes=getattr(writer, "idx_bytes_out", None))
 
@@ -245,6 +264,8 @@ class Journal:
             {"version": VERSION,
              "input_id": self.input_id,
              "holes_done": self.holes_done,
+             "holes_failed": self.holes_failed,
+             "holes_emitted": self.holes_emitted,
              "out_bytes": self.out_bytes,
              "idx_bytes": self.idx_bytes,
              "fingerprint": self.fingerprint},

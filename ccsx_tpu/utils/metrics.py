@@ -51,6 +51,52 @@ def resource_gauges() -> dict:
     return {"peak_rss_bytes": int(peak), "device_buffer_bytes": int(dev)}
 
 
+class FailureBudgetExceeded(RuntimeError):
+    """Raised by check_failure_budget when --max-failed-holes is
+    exceeded: the run aborts with RC_FAILED_HOLES (exitcodes.py)
+    instead of quarantining its way to a near-empty output at rc 0."""
+
+
+def check_failure_budget(metrics: "Metrics", cfg, final: bool = False):
+    """Enforce cfg.max_failed_holes (None = unbounded, the historical
+    behavior).  A value >= 1 is an absolute COUNT, checked the moment a
+    hole fails (exceeding it aborts immediately); a value in (0, 1) is
+    a FRACTION of processed holes (failed + emitted), checked at end of
+    run — mid-run the denominator is still growing, so a fraction can
+    only be judged early against a KNOWN total (the BGZF index
+    sidecar's holes_total), where no future success can dilute it back
+    under budget."""
+    budget = getattr(cfg, "max_failed_holes", None)
+    if budget is None:
+        return
+    failed = metrics.holes_failed
+    if not 0 < budget < 1:   # absolute count (0 = abort on any failure)
+        if failed > int(budget):
+            raise FailureBudgetExceeded(
+                f"failed-hole budget exceeded: {failed} holes failed "
+                f"(--max-failed-holes {int(budget)})")
+        return
+    total = metrics.holes_total
+    if total and failed > budget * total:
+        raise FailureBudgetExceeded(
+            f"failed-hole budget exceeded: {failed} of {total} input "
+            f"holes failed (> {budget:.0%}, --max-failed-holes "
+            f"{budget:g})")
+    if final:
+        # the denominator spans the whole LOGICAL run: this session's
+        # emissions plus prior sessions' journaled ones (holes_failed
+        # is already cumulative via the journal restore — judging old
+        # failures against only a short resume tail's successes would
+        # spuriously abort an overwhelmingly-healthy run)
+        done = (failed + metrics.holes_out
+                + metrics.holes_prior_emitted)
+        if done and failed > budget * done:
+            raise FailureBudgetExceeded(
+                f"failed-hole budget exceeded: {failed} of {done} "
+                f"processed holes failed (> {budget:.0%}, "
+                f"--max-failed-holes {budget:g})")
+
+
 @dataclasses.dataclass
 class Metrics:
     verbose: int = 0
@@ -77,6 +123,22 @@ class Metrics:
     oom_resplits: int = 0
     host_fallbacks: int = 0
     compile_fallbacks: int = 0
+    # resilient execution (pipeline/resilience.py): dispatches abandoned
+    # past --dispatch-deadline (each one recovered on the host path),
+    # and the backend circuit breaker's state machine — trips (closed ->
+    # open on N strikes in the window), half-open probes, the live
+    # state string, and a bounded log of the qualifying strikes
+    # (hang / compile / oom ladder-bottom, each {ts, kind, group})
+    # prior sessions' emitted holes, restored from the journal on
+    # resume (internal: feeds the --max-failed-holes fraction
+    # denominator only — holes_out stays THIS session's emission count
+    # so rates/progress are unaffected)
+    holes_prior_emitted: int = 0
+    device_hangs: int = 0
+    breaker_trips: int = 0
+    breaker_probes: int = 0
+    breaker_state: str = "closed"
+    breaker_strike_log: list = dataclasses.field(default_factory=list)
     # padding accounting for the batched device rounds (SURVEY §7.3
     # item 2 names padding waste the main throughput risk): real = DP
     # fill cells belonging to real pass-rows at their true qlen;
@@ -340,6 +402,10 @@ class Metrics:
             "oom_resplits": self.oom_resplits,
             "host_fallbacks": self.host_fallbacks,
             "compile_fallbacks": self.compile_fallbacks,
+            "device_hangs": self.device_hangs,
+            "breaker_state": self.breaker_state,
+            "breaker_trips": self.breaker_trips,
+            "breaker_probes": self.breaker_probes,
             "dp_cells_real": self.dp_cells_real,
             "dp_cells_padded": self.dp_cells_padded,
             "dp_occupancy": round(self.dp_cells_real
@@ -394,6 +460,10 @@ class Metrics:
             # dict() copy: the telemetry thread snapshots while the
             # ingest loop may be inserting a new reason bucket
             snap["filtered_reasons"] = dict(self.filtered_reasons)
+        if self.breaker_strike_log:
+            # list() copy: the breaker publishes a fresh list per
+            # strike, but a scraper could catch the reassignment
+            snap["breaker_strike_log"] = list(self.breaker_strike_log)
         if self.group_stats:
             snap["groups"] = self._group_table()
             snap["groups_forced"] = bool(self.groups_forced)

@@ -63,10 +63,13 @@ REPORT_TILE_KEYS = (
     "prep_share", "prep_overlap_share",
     "distinct_slab_shapes", "holes_filtered",
 )
-# final-event counters the header table renders
+# final-event counters the header table renders (device_hangs /
+# breaker_* are the resilient-execution story: abandoned dispatches and
+# the circuit breaker's verdict ride every run report)
 REPORT_HEADER_KEYS = (
     "holes_in", "holes_out", "holes_failed", "holes_filtered",
     "windows", "device_dispatches", "oom_resplits", "host_fallbacks",
+    "device_hangs", "breaker_trips", "breaker_state",
     "stalls", "elapsed_s", "ingest_bytes",
 )
 

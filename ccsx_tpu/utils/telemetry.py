@@ -61,6 +61,9 @@ PROM_COUNTERS = (
     "holes_in", "holes_out", "holes_failed", "holes_filtered", "stalls",
     "windows", "pair_alignments", "device_dispatches", "refine_overflows",
     "oom_resplits", "host_fallbacks", "compile_fallbacks",
+    # resilient execution (pipeline/resilience.py): abandoned
+    # dispatches + circuit-breaker trips and half-open probes
+    "device_hangs", "breaker_trips", "breaker_probes",
     "dp_cells_real", "dp_cells_padded", "distinct_slab_shapes",
     "fused_waves", "ingest_bytes",
 )
@@ -78,7 +81,8 @@ PROM_GAUGES = (
 )
 # snapshot keys with dedicated (non-scalar) renderings
 PROM_STRUCTURED = ("groups", "groups_forced", "degraded", "progress",
-                   "filtered_reasons")
+                   "filtered_reasons", "breaker_state",
+                   "breaker_strike_log")
 # per-group table fields exported as ccsx_group_<field>{group="..."}
 GROUP_FIELDS = ("compiles", "compile_s", "execute_s", "dispatches",
                 "dp_cells", "dp_cells_per_sec")
@@ -89,12 +93,13 @@ PROGRESS_KEYS = ("done", "total", "rate_zmws_per_sec", "elapsed_s",
 TOP_SUM_KEYS = (
     "holes_in", "holes_out", "holes_failed", "holes_filtered", "stalls",
     "windows", "device_dispatches", "oom_resplits", "host_fallbacks",
-    "refine_overflows", "ingest_bytes",
+    "refine_overflows", "device_hangs", "breaker_trips", "ingest_bytes",
 )
 # /healthz detail fields (rc-relevant: what an operator triages by)
 HEALTH_DETAIL_KEYS = ("stalls", "oom_resplits", "host_fallbacks",
                       "holes_failed", "compile_fallbacks",
-                      "refine_overflows")
+                      "refine_overflows", "device_hangs",
+                      "breaker_trips", "breaker_state")
 
 
 # ---- Prometheus text rendering --------------------------------------------
@@ -149,6 +154,14 @@ def render_prometheus(snap: dict, gauges: Optional[dict] = None) -> str:
     if "groups_forced" in snap:
         sample("groups_forced", int(bool(snap["groups_forced"])), "gauge")
     sample("degraded", int(bool(snap.get("degraded"))), "gauge")
+    # circuit-breaker state as a labeled gauge: exactly one sample, its
+    # label naming the current state (closed / open / half-open) — the
+    # alerting-friendly rendering (breaker_strike_log stays JSON-only:
+    # /progress carries it verbatim)
+    state = snap.get("breaker_state")
+    if state:
+        sample("breaker_state", 1, "gauge",
+               labels=f'{{state="{_prom_escape(state)}"}}')
     for key, v in sorted((gauges or {}).items()):
         sample(key, v, "gauge")
     return "\n".join(lines) + "\n"
@@ -425,12 +438,15 @@ def render_top(sources: List[dict], agg: dict, color: bool = True) -> str:
            else " total unknown — rate only"),
     ]
     if (agg["stalls"] or agg["oom_resplits"] or agg["host_fallbacks"]
-            or agg["holes_failed"]):
+            or agg["holes_failed"] or agg["device_hangs"]
+            or agg["breaker_trips"]):
         lines.append(c(_YELLOW,
                        f"  incidents: stalls {agg['stalls']}  "
                        f"oom_resplits {agg['oom_resplits']}  "
                        f"host_fallbacks {agg['host_fallbacks']}  "
-                       f"holes_failed {agg['holes_failed']}"))
+                       f"holes_failed {agg['holes_failed']}  "
+                       f"device_hangs {agg['device_hangs']}  "
+                       f"breaker_trips {agg['breaker_trips']}"))
     lines.append(c(_DIM, f"  {'source':<32} {'status':<18} "
                          f"{'out':>8} {'rate':>8} {'pct':>6}"))
     for s in sources:

@@ -88,6 +88,13 @@ OCCUPANCY_KEYS = ("dp_occupancy", "dp_round_occupancy", "dp_length_fill",
                   "prep_overlap_share", "zmws_per_sec",
                   "device_dispatches", "holes_out", "elapsed_s")
 
+# metrics-snapshot keys the stats resilience recap consumes (the
+# dispatch-deadline / circuit-breaker / recovery story of a run) —
+# schema-guarded like OCCUPANCY_KEYS (tests/test_telemetry.py)
+RESILIENCE_KEYS = ("device_hangs", "breaker_state", "breaker_trips",
+                   "breaker_probes", "host_fallbacks", "oom_resplits",
+                   "compile_fallbacks", "holes_failed", "stalls")
+
 _current: Optional["Tracer"] = None
 
 # the stall watchdog multiplies its timeout by this for the FIRST
@@ -745,10 +752,17 @@ def summarize(paths, top: int = 10) -> dict:
 
     mrec = final or last_metrics
     occupancy = {}
+    resilience = {}
     if mrec:
         for k in OCCUPANCY_KEYS:
             if mrec.get(k) is not None:
                 occupancy[k] = mrec[k]
+        for k in RESILIENCE_KEYS:
+            if mrec.get(k) is not None:
+                resilience[k] = mrec[k]
+        if mrec.get("breaker_strike_log"):
+            resilience["breaker_strike_log"] = \
+                mrec["breaker_strike_log"]
     slowest = [e for _, _, e in
                sorted(slow_heap, key=lambda t: (-t[0], t[1]))]
     # a table built from span records came from a forced (--trace) run;
@@ -762,6 +776,7 @@ def summarize(paths, top: int = 10) -> dict:
                           for k, v in sorted(stages.items())},
         "slowest": slowest,
         "occupancy": occupancy,
+        "resilience": resilience,
         "stalls": [{"group": s.get("group"), "open_s": s.get("open_s")}
                    for s in stalls],
         "degraded": (mrec or {}).get("degraded"),
@@ -818,6 +833,19 @@ def format_summary(d: dict) -> str:
     if d["occupancy"]:
         lines.append("occupancy recap: " + "  ".join(
             f"{k}={v}" for k, v in d["occupancy"].items()))
+    res = d.get("resilience") or {}
+    # only worth a line when something actually happened (hangs, trips,
+    # fallbacks, quarantines) or the breaker is not in its rest state
+    if res and (any(res.get(k) for k in
+                    ("device_hangs", "breaker_trips", "host_fallbacks",
+                     "oom_resplits", "holes_failed", "stalls"))
+                or res.get("breaker_state", "closed") != "closed"):
+        lines.append("resilience recap: " + "  ".join(
+            f"{k}={v}" for k, v in res.items()
+            if k != "breaker_strike_log"))
+        for s in res.get("breaker_strike_log", []):
+            lines.append(f"  strike: kind={s.get('kind')} "
+                         f"group={s.get('group')} ts={s.get('ts')}")
     for s in d["stalls"]:
         lines.append(f"STALL: group={s['group']} open_s={s['open_s']}")
     lines.append(f"degraded: {d['degraded'] or 'none'}")

@@ -1,0 +1,88 @@
+"""Chaos soak harness (benchmarks/chaos.py): seeded randomized fault
+schedules through the full CLI with the byte-identity oracle.
+
+The FAST deterministic slice runs in tier-1 (`make chaos` runs exactly
+this file's not-slow tests): in-process faults only — device OOMs,
+storms, transient stalls, permanent hangs under a dispatch deadline —
+every trial asserting bytes equal to the fault-free run.  The full
+soak (kill/resume subprocesses + a shepherded rank death on top) is
+the `slow` mark and the benchmarks/chaos.py CLI.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks"))
+
+import chaos  # noqa: E402
+
+from ccsx_tpu.utils import faultinject  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _chaos_env(monkeypatch):
+    faultinject.disarm()
+    # unit-scale hang budgets: grace x1 (the chaos corpus compiles in
+    # seconds on CPU) and a bounded hang sleep so abandoned daemon
+    # threads don't linger an hour
+    monkeypatch.setenv("CCSX_DEADLINE_GRACE", "1")
+    monkeypatch.setenv("CCSX_FAULT_HANG_S", "60")
+    monkeypatch.setenv("CCSX_FAULT_STALL_S", "0.3")
+    yield
+    faultinject.disarm()
+
+
+def test_chaos_fast_slice(tmp_path):
+    """The deterministic tier-1 slice: 3 seeded in-process fault trials
+    on a 3-hole corpus, every one byte-identical to the fault-free
+    run.  Failures print the full per-trial detail (seeded: any red
+    trial is replayable with the same seed)."""
+    summary = chaos.run_trials(seed=0, trials=3, holes=3,
+                               include_kills=False,
+                               include_shepherd=False,
+                               tmp=str(tmp_path))
+    assert summary["n_trials"] == 3
+    assert summary["ok"], summary["trials"]
+    # the seeded schedule is deterministic: same seed, same specs
+    again = chaos.run_trials(seed=0, trials=3, holes=3,
+                             include_kills=False,
+                             include_shepherd=False,
+                             tmp=str(tmp_path))
+    assert [t["spec"] for t in again["trials"]] == \
+        [t["spec"] for t in summary["trials"]]
+
+
+def test_chaos_hang_trial_directly(tmp_path):
+    """The permanent-hang trial in isolation (the seeded menu draw
+    above may or may not include it): device_hang under a dispatch
+    deadline must complete byte-identical with the hang counted."""
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    in_fa = chaos.make_corpus(str(tmp_path), rng, 3)
+    ref = chaos.run_reference(in_fa, str(tmp_path))
+    r = chaos.trial_inproc(in_fa, str(tmp_path), ref, "device_hang",
+                           "device_hang@1",
+                           ("--dispatch-deadline", "2"))
+    assert r["ok"], r
+    assert r["counters"]["device_hangs"] >= 1
+    assert r["degraded"]
+
+
+@pytest.mark.slow
+def test_chaos_soak_with_kills_and_shepherd(tmp_path):
+    """The full composition: randomized in-process faults + write/
+    journal kill-and-resume subprocesses + a shepherded rank death —
+    all byte-identical.  (slow: multiple cold CLI subprocesses.)"""
+    summary = chaos.run_trials(seed=1, trials=4, holes=4,
+                               include_kills=True,
+                               include_shepherd=True,
+                               tmp=str(tmp_path))
+    assert summary["ok"], summary["trials"]
+    kinds = {t["kind"] for t in summary["trials"]}
+    assert "kill_write" in kinds and "kill_journal" in kinds
+    assert "shepherd_rank_death" in kinds

@@ -1,0 +1,485 @@
+"""Resilient execution layer (ISSUE 9, ARCHITECTURE.md "Resilient
+execution"): dispatch deadlines with host-fallback recovery, the
+backend circuit breaker, the failure-rate abort, and the exit-code
+taxonomy.
+
+Load-bearing guarantees pinned here: a PERMANENT injected device hang
+(device_hang fault + --dispatch-deadline) completes with output
+byte-identical to the fault-free run at rc 0 with the degraded mark —
+no human intervention, no infinite stall; a tripped breaker completes
+the run on the host path byte-identically; a half-open probe closes
+the breaker on success and re-opens it on failure; --max-failed-holes
+exits rc 2 instead of emitting a near-empty output at rc 0; and the
+documented exit codes cannot drift silently.
+
+The CLI tests share the SAME synthetic corpus geometry as
+tests/test_faults.py (700 bp, 5 passes) so the process-wide jit cache
+is shared across the two files in tier-1.
+"""
+
+import json
+import os
+import time
+import types
+
+import numpy as np
+import pytest
+
+from ccsx_tpu import cli, exitcodes
+from ccsx_tpu.pipeline import batch as batch_mod
+from ccsx_tpu.pipeline.batch import _run_groups_recovering, classify_failure
+from ccsx_tpu.pipeline.resilience import (CircuitBreaker, DeadlineExpired,
+                                          Resilience, bounded_call)
+from ccsx_tpu.utils import faultinject, synth
+from ccsx_tpu.utils.metrics import (FailureBudgetExceeded, Metrics,
+                                    check_failure_budget)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.disarm()
+    yield
+    faultinject.disarm()
+
+
+@pytest.fixture(autouse=True)
+def _fast_grace(monkeypatch):
+    """Unit-scale deadline budgets: grace x1 (a 2 s deadline means 2 s
+    even for first-of-shape calls) and a bounded hang sleep so the
+    abandoned daemon threads don't outlive the suite by an hour."""
+    monkeypatch.setenv("CCSX_DEADLINE_GRACE", "1")
+    monkeypatch.setenv("CCSX_FAULT_HANG_S", "60")
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    """(input fasta, fault-free reference output) — identical geometry
+    to tests/test_faults.py's corpus (shared jit cache)."""
+    tmp = tmp_path_factory.mktemp("resil")
+    rng = np.random.default_rng(0)
+    zs = [synth.make_zmw(rng, template_len=700, n_passes=5, movie="mv",
+                         hole=str(100 + h)) for h in range(3)]
+    fa = tmp / "in.fa"
+    fa.write_text(synth.make_fasta(zs))
+    ref = tmp / "ref.fa"
+    assert cli.main(["-A", "-m", "1000", "--batch", "on",
+                     str(fa), str(ref)]) == 0
+    return fa, ref
+
+
+def _final(mpath):
+    return [json.loads(line) for line in mpath.read_text().splitlines()][-1]
+
+
+# ---------- units: bounded calls + taxonomy ----------
+
+def test_bounded_call_semantics():
+    assert bounded_call(lambda: 42, 0) == 42          # inline fast path
+    assert bounded_call(lambda: 42, 5.0) == 42        # bounded, in time
+    with pytest.raises(ValueError, match="boom"):     # exceptions surface
+        bounded_call(lambda: (_ for _ in ()).throw(ValueError("boom")),
+                     5.0)
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExpired, match="exceeded"):
+        bounded_call(lambda: time.sleep(30), 0.2, "g", "dispatch")
+    # the waiter returns promptly; the wedged thread is left parked
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_classify_failure_hang():
+    assert classify_failure(
+        DeadlineExpired("packed:q1024", "dispatch", 2.0)) == "hang"
+    # the existing classes are untouched
+    assert classify_failure(RuntimeError("RESOURCE_EXHAUSTED: x")) == "oom"
+    assert classify_failure(ValueError("bad draft")) == "data"
+
+
+def test_deadline_grace_first_call_only():
+    cfg = types.SimpleNamespace(dispatch_deadline_s=2.0,
+                                breaker_strikes=3, breaker_window_s=60.0,
+                                breaker_probe_s=0.0)
+    r = Resilience(cfg)
+    assert r.grace == 1.0  # _fast_grace fixture
+    b1 = r.budget("g", "dispatch")
+    b2 = r.budget("g", "dispatch")
+    assert b1 == b2 == 2.0
+    os.environ["CCSX_DEADLINE_GRACE"] = "10"
+    try:
+        r = Resilience(cfg)
+        assert r.budget("g", "dispatch") == 20.0   # first: compile grace
+        assert r.budget("g", "dispatch") == 2.0    # steady state
+        assert r.budget("g", "materialize") == 20.0  # per-phase first
+    finally:
+        os.environ["CCSX_DEADLINE_GRACE"] = "1"
+
+
+# ---------- units: circuit breaker ----------
+
+def test_breaker_trips_and_probes():
+    m = Metrics()
+    b = CircuitBreaker(strikes=2, window_s=60.0, probe_s=0.05, metrics=m)
+    assert b.admit() == "closed" and b.state == "closed"
+    b.strike("oom", "g")
+    assert b.admit() == "closed"          # one strike: still closed
+    b.strike("hang", "g")
+    assert b.state == "open" and m.breaker_trips == 1
+    assert b.admit() == "host"            # open, probe not due yet
+    time.sleep(0.06)
+    assert b.admit() == "probe"           # half-open probe admitted
+    assert b.state == "half-open" and m.breaker_probes == 1
+    assert b.admit() == "host"            # only ONE probe in flight
+    b.strike("oom", "g", probe=True)      # probe failed: re-open
+    assert b.state == "open"
+    time.sleep(0.06)
+    assert b.admit() == "probe"           # next probe
+    b.probe_succeeded()                   # THE probe succeeded: closed
+    assert b.state == "closed" and m.breaker_state == "closed"
+    assert b.admit() == "closed"
+    # strike log is bounded and rides Metrics
+    assert len(m.breaker_strike_log) == 3
+    assert {s["kind"] for s in m.breaker_strike_log} == {"oom", "hang"}
+
+
+def test_breaker_probe_verdict_is_token_bound():
+    """A pre-trip group finishing mid-probe must not close the breaker
+    (stale evidence), and a non-probe data failure must not steal the
+    probe's settlement."""
+    b = CircuitBreaker(strikes=1, window_s=60.0, probe_s=0.05)
+    b.strike("oom", "g")
+    assert b.state == "open"
+    time.sleep(0.06)
+    assert b.admit() == "probe"
+    # non-probe strike while the probe is in flight: counted/ignored,
+    # but the probe stays outstanding (state half-open)
+    b.strike("oom", "other")
+    assert b.state == "half-open"
+    # the probe's own failure is what re-opens
+    b.strike("hang", "g", probe=True)
+    assert b.state == "open"
+
+
+def test_breaker_probe_settles_on_data_failure():
+    """A probe group that fails with a per-hole `data` error strikes
+    nothing — but the probe token must still be released, or the
+    breaker wedges half-open forever (admit() refuses everything and
+    success() can then never run)."""
+    m = Metrics()
+    cfg = types.SimpleNamespace(dispatch_deadline_s=0.0,
+                                breaker_strikes=1, breaker_window_s=60.0,
+                                breaker_probe_s=0.05)
+    resil = Resilience(cfg, metrics=m)
+    calls = {"n": 0}
+
+    def dispatch(idxs, key):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("RESOURCE_EXHAUSTED: injected")  # trip
+        if calls["n"] == 2:
+            raise ValueError("bad draft")                # data probe
+        return np.zeros(2)
+
+    def finish(idxs, key, out):
+        results[0] = "device"
+
+    def run_one():
+        _run_groups_recovering({"g": [0]}, dispatch, finish,
+                               lambda i: "host", results, m,
+                               label=lambda k: "grp", resil=resil)
+
+    results = [None]
+    run_one()                       # OOM ladder-bottom: trip open
+    assert m.breaker_state == "open"
+    time.sleep(0.06)
+    results = [None]
+    run_one()                       # probe fails with a DATA error
+    assert results[0] == "host"
+    # not wedged half-open: back to open with a re-armed probe timer
+    assert m.breaker_state == "open"
+    time.sleep(0.06)
+    results = [None]
+    run_one()                       # next probe succeeds: closed
+    assert results[0] == "device" and m.breaker_state == "closed"
+
+
+def test_breaker_disabled_and_window():
+    b = CircuitBreaker(strikes=0)
+    for _ in range(10):
+        b.strike("oom", "g")
+        assert b.admit() and b.state == "closed"   # disabled: inert
+    b = CircuitBreaker(strikes=2, window_s=0.05)
+    b.strike("oom", "g")
+    time.sleep(0.08)
+    b.strike("oom", "g")          # first strike aged out of the window
+    assert b.state == "closed"
+
+
+def test_breaker_probe_recovers_through_recovery_ladder():
+    """Half-open re-probe at the _run_groups_recovering level: trip on
+    a ladder-bottom OOM, host-path completion while open, then a
+    successful probe closes the breaker and device dispatch resumes."""
+    m = Metrics()
+    cfg = types.SimpleNamespace(dispatch_deadline_s=0.0,
+                                breaker_strikes=1, breaker_window_s=60.0,
+                                breaker_probe_s=0.05)
+    resil = Resilience(cfg, metrics=m)
+    calls = {"n": 0}
+
+    def dispatch(idxs, key):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("RESOURCE_EXHAUSTED: injected")
+        return np.zeros(2)
+
+    def finish(idxs, key, out):
+        for i in idxs:
+            results[i] = "device"
+
+    def host_one(i):
+        return "host"
+
+    def run_one():
+        _run_groups_recovering({"g": [0]}, dispatch, finish, host_one,
+                               results, m, label=lambda k: "grp",
+                               resil=resil)
+
+    # 1-request group OOMs -> ladder bottom -> strike -> trip (strikes=1)
+    results = [None]
+    run_one()
+    assert results[0] == "host" and m.breaker_state == "open"
+    assert m.breaker_trips == 1 and m.host_fallbacks == 1
+    # while open: host path, the device is never touched
+    results = [None]
+    run_one()
+    assert results[0] == "host" and calls["n"] == 1
+    # probe due: one device dispatch, success closes the breaker
+    time.sleep(0.06)
+    results = [None]
+    run_one()
+    assert results[0] == "device" and calls["n"] == 2
+    assert m.breaker_state == "closed" and m.breaker_probes == 1
+
+
+# ---------- CLI: hang recovery (THE acceptance case) ----------
+
+def test_injected_permanent_hang_completes_byte_identical(
+        corpus, tmp_path, capsys):
+    """A permanently wedged dispatch (device_hang sleeps 60 s, far past
+    any budget here) is abandoned at the --dispatch-deadline and its
+    group replays on the host path: the run completes byte-identical
+    to the fault-free run at rc 0, marked degraded, with no human
+    intervention and no infinite stall."""
+    fa, ref = corpus
+    out = tmp_path / "o.fa"
+    m = tmp_path / "m.jsonl"
+    faultinject.arm("device_hang@1")
+    t0 = time.monotonic()
+    rc = cli.main(["-A", "-m", "1000", "--batch", "on",
+                   "--dispatch-deadline", "2",
+                   "--metrics", str(m), str(fa), str(out)])
+    assert rc == 0
+    assert time.monotonic() - t0 < 60  # did NOT wait out the hang
+    assert out.read_bytes() == ref.read_bytes()
+    final = _final(m)
+    assert final["device_hangs"] >= 1
+    assert final["degraded"]
+    assert final["host_fallbacks"] >= 1
+    err = capsys.readouterr().err
+    assert "dispatch deadline" in err and "host path" in err
+
+
+def test_deadline_off_is_todays_behavior(corpus, tmp_path):
+    """Resilience off (--dispatch-deadline 0, the default): output is
+    byte-identical and no resilience counters move.  (The transient
+    `stall` fault still completes without a deadline — it sleeps and
+    returns, it does not wedge.)"""
+    fa, ref = corpus
+    out = tmp_path / "o.fa"
+    m = tmp_path / "m.jsonl"
+    faultinject.arm("stall@1")
+    os.environ["CCSX_FAULT_STALL_S"] = "0.1"
+    try:
+        rc = cli.main(["-A", "-m", "1000", "--batch", "on",
+                       "--metrics", str(m), str(fa), str(out)])
+    finally:
+        del os.environ["CCSX_FAULT_STALL_S"]
+    assert rc == 0
+    assert out.read_bytes() == ref.read_bytes()
+    final = _final(m)
+    assert final["device_hangs"] == 0
+    assert final["breaker_trips"] == 0
+    assert final["breaker_state"] == "closed"
+
+
+# ---------- CLI: breaker trip -> host-path completion ----------
+
+def test_breaker_trip_completes_on_host_path(corpus, tmp_path, capsys):
+    fa, ref = corpus
+    out = tmp_path / "o.fa"
+    m = tmp_path / "m.jsonl"
+    faultinject.arm("device_oom@1+")
+    rc = cli.main(["-A", "-m", "1000", "--batch", "on",
+                   "--breaker-strikes", "2",
+                   "--metrics", str(m), str(fa), str(out)])
+    faultinject.disarm()
+    assert rc == 0
+    assert out.read_bytes() == ref.read_bytes()
+    final = _final(m)
+    assert final["breaker_trips"] >= 1
+    assert final["breaker_state"] == "open"   # no probe configured
+    assert final["host_fallbacks"] >= 1
+    assert len(final["breaker_strike_log"]) >= 2
+    assert "CIRCUIT BREAKER OPEN" in capsys.readouterr().err
+
+
+# ---------- CLI: failure-rate abort (--max-failed-holes) ----------
+
+def test_failed_hole_count_budget_aborts_rc2(corpus, tmp_path, capsys):
+    fa, _ = corpus
+    for batch in ("on", "off"):
+        out = tmp_path / f"o_{batch}.fa"
+        faultinject.arm("compute@1+")
+        rc = cli.main(["-A", "-m", "1000", "--batch", batch,
+                       "--max-failed-holes", "1", str(fa), str(out)])
+        faultinject.disarm()
+        assert rc == exitcodes.RC_FAILED_HOLES == 2
+        assert "failed-hole budget exceeded" in capsys.readouterr().err
+
+
+def test_failed_hole_fraction_budget(corpus, tmp_path, capsys):
+    """Fraction form: settled at end of run against processed holes —
+    1 failure in 3 holes passes a 0.5 budget, fails a 0.1 budget."""
+    fa, _ = corpus
+    out = tmp_path / "o.fa"
+    faultinject.arm("compute@2")
+    rc = cli.main(["-A", "-m", "1000", "--batch", "on",
+                   "--max-failed-holes", "0.5", str(fa), str(out)])
+    faultinject.disarm()
+    assert rc == 0
+    faultinject.arm("compute@2")
+    rc = cli.main(["-A", "-m", "1000", "--batch", "on",
+                   "--max-failed-holes", "0.1", str(fa),
+                   str(tmp_path / "o2.fa")])
+    faultinject.disarm()
+    assert rc == exitcodes.RC_FAILED_HOLES
+    assert "failed-hole budget exceeded" in capsys.readouterr().err
+
+
+def test_failure_budget_units():
+    cfg = types.SimpleNamespace(max_failed_holes=None)
+    m = Metrics()
+    m.holes_failed = 10 ** 6
+    check_failure_budget(m, cfg)                     # unbounded: never
+    cfg.max_failed_holes = 0.0                       # count 0: any fails
+    with pytest.raises(FailureBudgetExceeded):
+        check_failure_budget(m, cfg)
+    m = Metrics()
+    m.holes_failed, m.holes_out = 2, 8
+    cfg.max_failed_holes = 2.0
+    check_failure_budget(m, cfg, final=True)         # at budget: ok
+    m.holes_failed = 3
+    with pytest.raises(FailureBudgetExceeded):
+        check_failure_budget(m, cfg)                 # past it: abort
+    m.holes_failed = 2
+    cfg.max_failed_holes = 0.25
+    check_failure_budget(m, cfg, final=True)         # 2/10 <= 25%
+    cfg.max_failed_holes = 0.1
+    with pytest.raises(FailureBudgetExceeded):
+        check_failure_budget(m, cfg, final=True)     # 2/10 > 10%
+    # fraction judged mid-run only against a KNOWN total
+    m2 = Metrics()
+    m2.holes_failed, m2.holes_total = 5, 10
+    cfg.max_failed_holes = 0.2
+    with pytest.raises(FailureBudgetExceeded):
+        check_failure_budget(m2, cfg)
+    # resumed runs: the fraction denominator spans the whole logical
+    # run (prior sessions' journaled emissions included) — 2 failures
+    # against 90 prior + 8 current successes is 2%, not 20%
+    m3 = Metrics()
+    m3.holes_failed, m3.holes_out, m3.holes_prior_emitted = 2, 8, 90
+    cfg.max_failed_holes = 0.05
+    check_failure_budget(m3, cfg, final=True)
+    m3.holes_prior_emitted = 0
+    with pytest.raises(FailureBudgetExceeded):
+        check_failure_budget(m3, cfg, final=True)
+
+
+def test_resilience_knobs_do_not_invalidate_resume():
+    """Deadline/breaker/budget knobs choose WHERE a request computes
+    (or the rc), never output bytes — adding them on a resume (the
+    canonical 'it hung, re-run WITH --dispatch-deadline' move) must
+    not refuse the journal as a config change."""
+    import dataclasses as dc
+
+    from ccsx_tpu.config import CcsConfig
+    from ccsx_tpu.utils.fingerprint import config_fingerprint
+
+    a = CcsConfig()
+    b = dc.replace(a, dispatch_deadline_s=30.0, breaker_strikes=5,
+                   breaker_window_s=10.0, breaker_probe_s=60.0,
+                   max_failed_holes=0.1)
+    assert config_fingerprint(a) == config_fingerprint(b)
+    # ...while an output-shaping field still invalidates
+    c = dc.replace(a, refine_iters=3)
+    assert config_fingerprint(a) != config_fingerprint(c)
+
+
+def test_cli_rejects_bad_budget(tmp_path, capsys):
+    # 1.5: a non-integer count would be silently int()-truncated to a
+    # tighter budget than asked — rejected at parse time instead
+    for bad in ("-3", "inf", "nan", "x", "1.5"):
+        rc = cli.main(["--max-failed-holes", bad, "x.fa",
+                       str(tmp_path / "y.fa")])
+        assert rc == 1, bad
+        assert "--max-failed-holes" in capsys.readouterr().err
+
+
+def test_failure_budget_survives_journal_resume(corpus, tmp_path):
+    """The budget is judged over the whole LOGICAL run: journaled
+    failures are restored on resume (journal v2 holes_failed), so a
+    resume cannot silently grant a fresh failure budget and complete
+    rc 0 with the near-empty output the flag refuses."""
+    fa, _ = corpus
+    out = tmp_path / "o.fa"
+    jp = tmp_path / "j.json"
+    args = ["-A", "-m", "1000", "--batch", "on", "--journal", str(jp),
+            "--max-failed-holes", "2", str(fa), str(out)]
+    os.environ["CCSX_JOURNAL_FSYNC_S"] = "0"
+    try:
+        faultinject.arm("compute@1+")
+        rc = cli.main(args)        # holes 1-2 fail within budget, 3 over
+        faultinject.disarm()
+        assert rc == exitcodes.RC_FAILED_HOLES
+        assert json.loads(jp.read_text())["holes_failed"] == 2
+        # the resume restores the 2 journaled failures: one more
+        # failure is over budget again — NOT a fresh budget of 2
+        faultinject.arm("compute@1+")
+        rc = cli.main(args)
+        faultinject.disarm()
+        assert rc == exitcodes.RC_FAILED_HOLES
+    finally:
+        del os.environ["CCSX_JOURNAL_FSYNC_S"]
+
+
+# ---------- exit-code taxonomy: pinned so it cannot drift ----------
+
+def test_exit_code_taxonomy_pinned():
+    assert exitcodes.RC_OK == 0
+    assert exitcodes.RC_FATAL == 1
+    assert exitcodes.RC_FAILED_HOLES == 2
+    assert exitcodes.RC_INJECTED_KILL == faultinject.EXIT_CODE == 57
+
+
+def test_exit_codes_documented():
+    """README and ARCHITECTURE.md carry the taxonomy table: every
+    documented code row must exist, so a code change forces a doc
+    change (and vice versa)."""
+    readme = open(os.path.join(_REPO, "README.md")).read()
+    arch = open(os.path.join(_REPO, "ARCHITECTURE.md")).read()
+    for doc, name in ((readme, "README"), (arch, "ARCHITECTURE")):
+        for row in ("| 0 |", "| 1 |", "| 2 |", "| 57 |"):
+            assert row in doc, f"{name} is missing exit-code row {row}"
+    assert "--max-failed-holes" in readme
+    assert "--dispatch-deadline" in readme
+    assert "shepherd" in readme

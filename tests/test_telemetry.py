@@ -343,11 +343,14 @@ def _populated_snapshot():
               "dp_rowcells_cap", "dp_rows_real", "dp_rows_dispatched",
               "packed_dispatches", "packed_holes",
               "distinct_slab_shapes", "fused_waves",
-              "fused_slabs_real", "fused_slots", "ingest_bytes"):
+              "fused_slabs_real", "fused_slots", "ingest_bytes",
+              "device_hangs", "breaker_trips", "breaker_probes"):
         setattr(m, f, 7)
     m.filtered_reasons["few_passes"] = 7
     m.holes_total = 100
     m.degraded = "x"
+    m.breaker_state = "open"
+    m.breaker_strike_log = [{"ts": 1.0, "kind": "hang", "group": "g"}]
     m.group_stats["g"] = {"compiles": 1, "compile_s": 0.1,
                           "execute_s": 0.2, "dispatches": 3,
                           "dp_cells": 40, "exec_cells": 30}
@@ -364,6 +367,7 @@ def test_schema_guard_every_consumed_key_exists():
             ("top sum keys", telemetry.TOP_SUM_KEYS),
             ("healthz detail", telemetry.HEALTH_DETAIL_KEYS),
             ("stats occupancy", trace.OCCUPANCY_KEYS),
+            ("stats resilience", trace.RESILIENCE_KEYS),
             ("report tiles", report_mod.REPORT_TILE_KEYS),
             ("report header", report_mod.REPORT_HEADER_KEYS)]:
         missing = set(keys) - set(snap)
