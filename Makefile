@@ -4,7 +4,7 @@
 PY ?= python
 PYTEST_FLAGS = -q -p no:cacheprovider -p no:xdist -p no:randomly
 
-.PHONY: chaos chaos-soak tier1 native
+.PHONY: chaos chaos-soak fuzz fuzz-sweep tier1 native
 
 # the deterministic tier-1 chaos slice (tests/test_chaos.py fast
 # tests): seeded fault schedules through the full CLI with the
@@ -12,6 +12,18 @@ PYTEST_FLAGS = -q -p no:cacheprovider -p no:xdist -p no:randomly
 # circuit breaker, and shepherd restart in one command
 chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py -m 'not slow' $(PYTEST_FLAGS)
+
+# the deterministic tier-1 corruption-fuzz slice (tests/
+# test_corrupt_fuzz.py fast tests): seeded hostile-input mutants
+# through the full CLI with the salvage invariant as oracle
+fuzz:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_corrupt_fuzz.py -m 'not slow' $(PYTEST_FLAGS)
+
+# the full >= 50-mutants-per-format sweep (also directly:
+# python benchmarks/corrupt.py --seed N --mutants 50)
+fuzz-sweep:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_corrupt_fuzz.py $(PYTEST_FLAGS)
+	JAX_PLATFORMS=cpu $(PY) benchmarks/corrupt.py --seed 0 --mutants 50
 
 # the full randomized soak (also available directly:
 # python benchmarks/chaos.py --seed N --trials T)

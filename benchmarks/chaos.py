@@ -20,6 +20,14 @@ run for every trial:
   the supervisor restarts it and the merged output must equal the
   unsharded run's bytes.
 
+* **Input-plane faults** (`disk_full`, `input_corrupt`): an injected
+  ENOSPC must exit through the clean rc-1 path with the journal
+  consistent and resume byte-identical; an injected classified
+  corruption under ``--salvage`` must complete rc 0 degraded with the
+  byte-identity oracle restricted to UNDAMAGED holes (the salvage
+  contract; real crafted-byte corruption is the corruption fuzzer's
+  domain, benchmarks/corrupt.py).
+
 Schedules are pure functions of ``--seed``, so any red trial is
 replayable exactly.  Deliberately NOT injected here: ``compute`` and
 ``ingest`` faults — they are *designed* to change the output
@@ -137,6 +145,67 @@ def trial_kill_resume(in_fa: str, tmp: str, ref: bytes, point: str,
             "ok": killed and rc == 0 and got == ref}
 
 
+def trial_disk_full_resume(in_fa: str, tmp: str, ref: bytes,
+                           n: int) -> dict:
+    """ENOSPC (injected OSError in the synchronous writer) must exit
+    through the clean rc-1 path with the journal consistent; the
+    resume must complete byte-identical — the disk-full reality of
+    long runs on shared scratch."""
+    out = os.path.join(tmp, "o_diskfull.fa")
+    jp = os.path.join(tmp, "j_diskfull.json")
+    args = _base_args(in_fa, out, ("--journal", jp))
+    os.environ["CCSX_JOURNAL_FSYNC_S"] = "0"
+    try:
+        faultinject.arm(f"disk_full@{n}")
+        rc1 = cli.main(args)
+        faultinject.disarm()
+        rc2 = cli.main(args)   # disk "freed": resume, no faults
+    finally:
+        faultinject.disarm()
+        os.environ.pop("CCSX_JOURNAL_FSYNC_S", None)
+    got = open(out, "rb").read() if os.path.exists(out) else b""
+    return {"kind": "disk_full_resume", "spec": f"disk_full@{n}",
+            "enospc_rc": rc1, "resume_rc": rc2,
+            "identical": got == ref,
+            "ok": rc1 == 1 and rc2 == 0 and got == ref}
+
+
+def trial_input_corrupt(in_fa: str, tmp: str, ref: bytes,
+                        n: int) -> dict:
+    """An injected classified corruption at the Nth ingested hole with
+    --salvage: the run must complete rc 0 degraded with exactly that
+    hole dropped — the byte-identity oracle restricted to UNDAMAGED
+    holes (the salvage contract, io/corruption.py)."""
+    out = os.path.join(tmp, "o_incorrupt.fa")
+    m = os.path.join(tmp, "m_incorrupt.jsonl")
+    faultinject.arm(f"input_corrupt@{n}")
+    try:
+        rc = cli.main(_base_args(in_fa, out,
+                                 ("--salvage", "--metrics", m)))
+    finally:
+        faultinject.disarm()
+    got = open(out, "rb").read() if os.path.exists(out) else b""
+    # undamaged-holes oracle: every emitted record must be byte-equal
+    # to its clean-run twin, and exactly one hole (the injected one)
+    # may be missing
+    def _by_hole(b):
+        return {c.split("\n", 1)[0]: c
+                for c in b.decode(errors="replace").split(">")[1:]}
+    r, s = _by_hole(ref), _by_hole(got)
+    sub_ok = all(s.get(k) == v for k, v in r.items() if k in s)
+    final = {}
+    try:
+        final = [json.loads(line) for line in open(m)][-1]
+    except (OSError, IndexError, ValueError):
+        pass
+    return {"kind": "input_corrupt", "spec": f"input_corrupt@{n}",
+            "rc": rc, "holes_corrupt": final.get("holes_corrupt"),
+            "degraded": bool(final.get("degraded")),
+            "ok": (rc == 0 and len(s) == len(r) - 1 and sub_ok
+                   and final.get("holes_corrupt") == 1
+                   and bool(final.get("degraded")))}
+
+
 def trial_shepherd_rank_death(in_fa: str, tmp: str, ref: bytes,
                               hosts: int, dead_rank: int,
                               n: int) -> dict:
@@ -163,11 +232,14 @@ def trial_shepherd_rank_death(in_fa: str, tmp: str, ref: bytes,
 def run_trials(seed: int, trials: int, holes: int,
                include_kills: bool = True,
                include_shepherd: bool = True,
+               include_input: bool = True,
                max_call: int = 4, tmp: str = None) -> dict:
     """The soak driver: ``trials`` seeded in-process fault trials plus
-    (optionally) one kill/resume trial per kill point and one shepherd
-    rank-death trial.  Returns the summary dict; ``summary["ok"]`` is
-    the one-bit verdict (every trial byte-identical)."""
+    (optionally) one kill/resume trial per kill point, one shepherd
+    rank-death trial, and the input-plane trials (disk_full ENOSPC +
+    resume; input_corrupt under --salvage with the undamaged-holes
+    oracle).  Returns the summary dict; ``summary["ok"]`` is the
+    one-bit verdict (every trial byte-identical / contract-clean)."""
     # unit-scale hang budgets unless the caller already chose: grace x1
     # (the chaos corpus compiles in seconds on CPU — 10x grace would
     # make every first-of-shape device_hang trial a ~20 s wait) and a
@@ -195,6 +267,14 @@ def run_trials(seed: int, trials: int, holes: int,
                 results.append(trial_kill_resume(
                     in_fa, tmp, ref, point,
                     int(rng.integers(1, max(holes, 2)))))
+        if include_input:
+            # the input failure domain mixed into the same soak: a
+            # disk-full abort + resume, and an injected classified
+            # corruption salvaged mid-run
+            results.append(trial_disk_full_resume(
+                in_fa, tmp, ref, int(rng.integers(1, max(holes, 2)))))
+            results.append(trial_input_corrupt(
+                in_fa, tmp, ref, int(rng.integers(1, holes + 1))))
         if include_shepherd:
             results.append(trial_shepherd_rank_death(
                 in_fa, tmp, ref, hosts=2, dead_rank=1,
@@ -223,11 +303,15 @@ def main():
                     help="skip the subprocess kill/resume trials")
     ap.add_argument("--no-shepherd", action="store_true",
                     help="skip the shepherd rank-death trial")
+    ap.add_argument("--no-input", action="store_true",
+                    help="skip the input-plane trials (disk_full, "
+                         "input_corrupt)")
     ap.add_argument("--json", default=None)
     a = ap.parse_args()
     summary = run_trials(a.seed, a.trials, a.holes,
                          include_kills=not a.no_kills,
-                         include_shepherd=not a.no_shepherd)
+                         include_shepherd=not a.no_shepherd,
+                         include_input=not a.no_input)
     print(json.dumps(summary, indent=1))
     if a.json:
         with open(a.json, "w") as f:

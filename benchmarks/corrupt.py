@@ -1,0 +1,370 @@
+"""Seeded corruption fuzzer: hostile-input mutants through the full CLI.
+
+The salvage invariant (ISSUE 10): for any corrupted input, the run must
+*never crash or hang*, its rc must come from the pinned exit-code
+taxonomy, and under ``--salvage`` every hole whose bytes are UNDAMAGED
+must emit byte-identical to the clean run — damage degrades per-hole,
+never per-file.  This harness makes that claim testable:
+
+* ``build_corpus`` writes a clean synthetic corpus per format (BGZF
+  BAM / FASTA / FASTQ) and records the byte LAYOUT — each hole's span
+  in the record stream, plus the BGZF block table for BAM — so a
+  mutation's blast radius can be mapped to the exact hole set it may
+  legally affect.
+* ``make_mutant`` applies one seeded mutation — bit flip, truncation,
+  or zero-run, at container-random, block, record, or field
+  granularity — and returns the damaged-hole set via the layout:
+  text formats map the mutated range onto hole spans directly; BGZF
+  maps it through the block table (a damaged block damages every hole
+  whose records overlap that block's inflated bytes; a truncation
+  damages everything from the first affected block on).
+* ``run_mutant`` drives the mutant through the full CLI and
+  ``check_invariant`` enforces the contract: rc from the taxonomy and,
+  with salvage on, per-hole byte identity for every undamaged hole.
+
+The fast deterministic slice runs in tier-1
+(tests/test_corrupt_fuzz.py, `make fuzz`); the full >= 50-mutants-per-
+format sweep is the `slow` mark and this CLI:
+
+    python benchmarks/corrupt.py --seed 0 --mutants 50 \
+        --json benchmarks/corrupt_rNN.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import struct
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from ccsx_tpu import cli                                     # noqa: E402
+from ccsx_tpu.io import bam as bam_mod                       # noqa: E402
+from ccsx_tpu.ops import encode as enc                       # noqa: E402
+from ccsx_tpu.utils import synth                             # noqa: E402
+
+FORMATS = ("bam", "fasta", "fastq")
+
+# rcs the taxonomy allows a corrupted-input run to exit with
+# (exitcodes.py): 0 = completed (possibly degraded/salvaged),
+# 1 = clean fail-fast refusal, 2 = failed-hole budget
+ALLOWED_RCS = (0, 1, 2)
+
+
+@dataclasses.dataclass
+class Corpus:
+    fmt: str
+    path: str
+    data: bytes
+    # hole name "movie/hole" -> (lo, hi) byte span.  Text formats: the
+    # file itself; BAM: the INFLATED record stream (4-byte length ints
+    # included), mapped through `blocks`
+    hole_spans: Dict[str, Tuple[int, int]]
+    # BGZF only: (c0, c1, u0, u1) per block — compressed file span ->
+    # inflated stream span
+    blocks: List[Tuple[int, int, int, int]]
+
+
+# ---- corpus builders -----------------------------------------------------
+
+
+def _zmws(rng, holes: int, template_len: int, n_passes: int):
+    return [synth.make_zmw(rng, template_len=template_len,
+                           n_passes=n_passes, movie="mv",
+                           hole=str(100 + h)) for h in range(holes)]
+
+
+def build_corpus(tmp: str, fmt: str, rng, holes: int = 4,
+                 template_len: int = 300, n_passes: int = 5) -> Corpus:
+    zs = _zmws(rng, holes, template_len, n_passes)
+    if fmt == "bam":
+        recs = []
+        for z in zs:
+            for name, p in zip(z.names, z.passes):
+                seq = enc.decode(p).encode()
+                recs.append((name, seq, b"I" * len(seq)))
+        path = os.path.join(tmp, "in.bam")
+        bam_mod.write_bam(path, recs, bgzf=True)
+        data = open(path, "rb").read()
+        blocks = _bgzf_blocks(data)
+        spans = _bam_hole_spans(blocks, data)
+        return Corpus(fmt, path, data, spans, blocks)
+    out = []
+    spans: Dict[str, Tuple[int, int]] = {}
+    off = 0
+    for z in zs:
+        start = off
+        for name, p in zip(z.names, z.passes):
+            seq = enc.decode(p).encode()
+            if fmt == "fasta":
+                rec = b">%s\n%s\n" % (name.encode(), seq)
+            else:
+                rec = b"@%s\n%s\n+\n%s\n" % (name.encode(), seq,
+                                             b"I" * len(seq))
+            out.append(rec)
+            off += len(rec)
+        spans[f"{z.movie}/{z.hole}"] = (start, off)
+    path = os.path.join(tmp, "in." + ("fa" if fmt == "fasta" else "fq"))
+    data = b"".join(out)
+    with open(path, "wb") as f:
+        f.write(data)
+    return Corpus(fmt, path, data, spans, [])
+
+
+def _bgzf_blocks(data: bytes) -> List[Tuple[int, int, int, int]]:
+    blocks = []
+    c = u = 0
+    while c < len(data):
+        (xlen,) = struct.unpack_from("<H", data, c + 10)
+        (bs,) = struct.unpack_from("<H", data, c + 16)   # BC is first
+        bsize = bs + 1
+        (isize,) = struct.unpack_from("<I", data, c + bsize - 4)
+        blocks.append((c, c + bsize, u, u + isize))
+        c += bsize
+        u += isize
+    return blocks
+
+
+def _bam_hole_spans(blocks, data: bytes) -> Dict[str, Tuple[int, int]]:
+    import zlib
+
+    inflated = b"".join(
+        zlib.decompress(data[c0 + 12 + struct.unpack_from(
+            "<H", data, c0 + 10)[0]:c1 - 8], -15)
+        for c0, c1, _, _ in blocks)
+    # walk header then records, grouping spans by hole
+    (l_text,) = struct.unpack_from("<i", inflated, 4)
+    off = 8 + l_text
+    (n_ref,) = struct.unpack_from("<i", inflated, off)
+    off += 4
+    for _ in range(n_ref):
+        (l_name,) = struct.unpack_from("<i", inflated, off)
+        off += 8 + l_name
+    spans: Dict[str, Tuple[int, int]] = {}
+    while off < len(inflated):
+        start = off
+        (bs,) = struct.unpack_from("<i", inflated, off)
+        lrn = inflated[off + 12]
+        name = inflated[off + 36:off + 36 + lrn - 1].decode()
+        off += 4 + bs
+        hole = "/".join(name.split("/")[:2])
+        lo, hi = spans.get(hole, (start, start))
+        spans[hole] = (min(lo, start), off)
+    return spans
+
+
+# ---- mutation + damage mapping -------------------------------------------
+
+
+@dataclasses.dataclass
+class Mutation:
+    kind: str          # flip | truncate | zeros
+    lo: int            # file-coordinate damage range [lo, hi)
+    hi: int
+    label: str
+
+
+def make_mutant(corpus: Corpus, rng) -> Tuple[bytes, Mutation]:
+    """One seeded mutation at a seeded granularity.  Returns the mutant
+    bytes + the Mutation (file coordinates, for damaged_holes)."""
+    data = bytearray(corpus.data)
+    kind = ("flip", "truncate", "zeros")[int(rng.integers(3))]
+    gran = ("anywhere", "record", "field")[int(rng.integers(3))]
+    if gran == "anywhere" or not corpus.hole_spans:
+        pos = int(rng.integers(0, len(data)))
+    else:
+        # inside a (seeded) hole's span — record/field granularity.
+        # BAM spans are in inflated coordinates: map onto a compressed
+        # offset inside one of the hole's covering blocks
+        hole = sorted(corpus.hole_spans)[
+            int(rng.integers(len(corpus.hole_spans)))]
+        lo, hi = corpus.hole_spans[hole]
+        upos = int(rng.integers(lo, hi))
+        if corpus.fmt == "bam":
+            blk = next(b for b in corpus.blocks if b[2] <= upos < b[3])
+            # field granularity: aim at the block's payload start (the
+            # deflate stream — any hit corrupts the whole block, which
+            # is exactly BGZF's blast radius); record: anywhere in it
+            c0, c1 = blk[0], blk[1]
+            pos = int(rng.integers(c0 + 18, c1)) if gran == "record" \
+                else int(rng.integers(c0, c0 + 18))
+        else:
+            pos = upos
+    if kind == "flip":
+        data[pos] ^= 1 << int(rng.integers(0, 8))
+        lo_hi = (pos, pos + 1)
+    elif kind == "truncate":
+        pos = max(1, pos)
+        del data[pos:]
+        lo_hi = (pos, len(corpus.data))
+    else:
+        n = int(rng.integers(4, 64))
+        data[pos:pos + n] = b"\x00" * min(n, len(data) - pos)
+        lo_hi = (pos, min(pos + n, len(corpus.data)))
+    return bytes(data), Mutation(kind, lo_hi[0], lo_hi[1],
+                                 f"{kind}@{lo_hi[0]}-{lo_hi[1]}:{gran}")
+
+
+def damaged_holes(corpus: Corpus, mut: Mutation) -> Set[str]:
+    """The hole set a mutation may legally affect.  Every hole OUTSIDE
+    this set must emit byte-identical to the clean run under
+    --salvage."""
+    lo, hi = mut.lo, mut.hi
+    if mut.kind == "truncate":
+        hi = len(corpus.data)
+    if corpus.fmt == "bam":
+        # damaged compressed range -> union of affected blocks'
+        # inflated spans (a corrupt block is dropped whole); a
+        # truncation additionally kills everything after its block
+        ulo = uhi = None
+        for c0, c1, u0, u1 in corpus.blocks:
+            if c0 < hi and lo < c1:
+                ulo = u0 if ulo is None else min(ulo, u0)
+                uhi = u1 if uhi is None else max(uhi, u1)
+        if ulo is None:
+            return set()
+        if mut.kind == "truncate":
+            uhi = corpus.blocks[-1][3]
+        return {h for h, (s0, s1) in corpus.hole_spans.items()
+                if s0 < uhi and ulo < s1}
+    return {h for h, (s0, s1) in corpus.hole_spans.items()
+            if s0 < hi and lo < s1}
+
+
+# ---- the CLI drive + invariant -------------------------------------------
+
+
+def _cli_args(corpus_fmt: str, in_path: str, out: str,
+              salvage: bool, extra=()) -> list:
+    args = ["-m", "100", "--batch", "on",
+            "--dispatch-deadline", "30", "--stall-timeout", "15"]
+    if corpus_fmt != "bam":
+        args.append("-A")
+    if salvage:
+        args.append("--salvage")
+    return [*args, *extra, in_path, out]
+
+
+def by_hole(fasta_bytes: bytes) -> Dict[str, str]:
+    """Output FASTA -> {"movie/hole": record text} (names are
+    movie/hole/ccs)."""
+    out = {}
+    for chunk in fasta_bytes.decode(errors="replace").split(">")[1:]:
+        name = chunk.split("\n", 1)[0]
+        out["/".join(name.split("/")[:2])] = chunk
+    return out
+
+
+def run_mutant(corpus: Corpus, mut_bytes: bytes, mut: Mutation,
+               tmp: str, ref: Dict[str, str], i: int,
+               salvage: bool) -> dict:
+    ext = {"bam": "bam", "fasta": "fa", "fastq": "fq"}[corpus.fmt]
+    mp = os.path.join(tmp, f"mut{i}.{ext}")
+    with open(mp, "wb") as f:
+        f.write(mut_bytes)
+    out = os.path.join(tmp, f"out{i}.fa")
+    t0 = time.monotonic()
+    rc = cli.main(_cli_args(corpus.fmt, mp, out, salvage))
+    wall = time.monotonic() - t0
+    got = by_hole(open(out, "rb").read()) if os.path.exists(out) else {}
+    dam = damaged_holes(corpus, mut)
+    bad = []
+    if rc not in ALLOWED_RCS:
+        bad.append(f"rc {rc} outside the pinned taxonomy")
+    if salvage:
+        if rc != 0:
+            bad.append(f"salvage run exited rc {rc}")
+        for h in ref:
+            if h in dam:
+                continue
+            if got.get(h) != ref[h]:
+                bad.append(f"undamaged hole {h} not byte-identical")
+    return {"i": i, "mutation": mut.label, "salvage": salvage,
+            "rc": rc, "wall_s": round(wall, 2),
+            "damaged": sorted(dam), "emitted": len(got),
+            "ok": not bad, "bad": bad}
+
+
+def run_sweep(seed: int, mutants: int, formats=FORMATS,
+              salvage_share: float = 0.7, holes: int = 4,
+              tmp: Optional[str] = None) -> dict:
+    """``mutants`` seeded mutants per format through the full CLI;
+    ~``salvage_share`` of them with --salvage (full invariant), the
+    rest fail-fast (rc taxonomy only).  Returns the summary dict;
+    ``summary["ok"]`` is the verdict."""
+    rng = np.random.default_rng(seed)
+    own = tmp is None
+    tmp = tmp or tempfile.mkdtemp(prefix="ccsx_corrupt_")
+    results = []
+    t0 = time.monotonic()
+    try:
+        for fmt in formats:
+            corpus = build_corpus(tmp, fmt, rng, holes=holes)
+            refp = os.path.join(tmp, f"ref_{fmt}.fa")
+            rc = cli.main(_cli_args(fmt, corpus.path, refp, False))
+            assert rc == 0, f"clean {fmt} reference run failed rc={rc}"
+            ref = by_hole(open(refp, "rb").read())
+            # zero-overhead-when-healthy: salvage on the CLEAN input
+            svp = os.path.join(tmp, f"ref_{fmt}_sv.fa")
+            rc = cli.main(_cli_args(fmt, corpus.path, svp, True))
+            clean_ok = (rc == 0 and open(svp, "rb").read()
+                        == open(refp, "rb").read())
+            results.append({"i": -1, "mutation": f"{fmt}:clean",
+                            "salvage": True, "rc": rc, "wall_s": 0,
+                            "damaged": [], "emitted": len(ref),
+                            "ok": clean_ok,
+                            "bad": [] if clean_ok else
+                            ["salvage-on clean run not byte-identical"]})
+            for i in range(mutants):
+                mut_bytes, mut = make_mutant(corpus, rng)
+                salvage = rng.random() < salvage_share
+                r = run_mutant(corpus, mut_bytes, mut, tmp, ref, i,
+                               salvage)
+                r["fmt"] = fmt
+                results.append(r)
+    finally:
+        if own:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+    bad = [r for r in results if not r["ok"]]
+    return {"seed": seed, "mutants_per_format": mutants,
+            "formats": list(formats), "n_trials": len(results),
+            "n_failed": len(bad), "failed": bad, "ok": not bad,
+            "elapsed_s": round(time.monotonic() - t0, 1)}
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Seeded corruption fuzzer: mutants through the "
+                    "full CLI with the salvage invariant as oracle")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--mutants", type=int, default=50,
+                    help="mutants per format [50]")
+    ap.add_argument("--formats", default=",".join(FORMATS))
+    ap.add_argument("--holes", type=int, default=4)
+    ap.add_argument("--json", default=None)
+    a = ap.parse_args()
+    summary = run_sweep(a.seed, a.mutants,
+                        formats=tuple(a.formats.split(",")),
+                        holes=a.holes)
+    print(json.dumps({k: v for k, v in summary.items()
+                      if k != "failed"} | {"failed": summary["failed"]},
+                     indent=1))
+    if a.json:
+        with open(a.json, "w") as f:
+            json.dump(summary, f, indent=1)
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,6 +77,17 @@ TPU extensions (long options):
                            or a fraction in (0,1) of processed holes;
                            exceeding it exits rc 2 instead of emitting
                            a near-empty output at rc 0) [unbounded]
+--salvage                 (hostile-input salvage: classified input
+                           corruption — torn BGZF blocks, corrupt BAM
+                           records, truncated FASTQ, bad ZMW names —
+                           is booked, the reader RESYNCS, and every
+                           undamaged hole still emits; the run exits 0
+                           marked degraded, corrupt holes spend the
+                           --max-failed-holes budget.  Off = today's
+                           fail-fast rc 1 on the first corrupt byte)
+--max-record-bytes <n>    (allocation bound on one BAM record: a
+                           corrupt length field larger than this is
+                           rejected BEFORE allocating) [268435456]
 --hosts <int> --host-id <int> --coordinator <addr> --merge-shards <N>
 --merge-unmarked          (merge a legacy shard set without .done markers)
 --make-index              (index INPUT for byte-range sharded ingest)
@@ -301,11 +312,30 @@ def build_parser() -> argparse.ArgumentParser:
                         "run).  Exceeding it exits rc 2 instead of "
                         "emitting a near-empty output at rc 0 "
                         "[unbounded]")
+    # hostile-input ingest plane (io/corruption.py)
+    p.add_argument("--salvage", action="store_true", dest="salvage",
+                   help="Salvage-mode ingest: classified input "
+                        "corruption (io/corruption.py taxonomy) is "
+                        "counted + resynced past — BGZF rescans for "
+                        "the next valid block, BAM for the next "
+                        "plausible record, FASTA/Q for the next "
+                        "'>'/'@' line — instead of killing the run; "
+                        "every undamaged hole still emits, the run is "
+                        "marked degraded, and corrupt holes spend the "
+                        "--max-failed-holes budget.  Default off: "
+                        "fail-fast rc 1 on the first corrupt byte")
+    p.add_argument("--max-record-bytes", type=int, default=None,
+                   dest="max_record_bytes", metavar="N",
+                   help="Allocation bound on one BAM alignment record "
+                        "(enforced BEFORE allocating; a corrupt int32 "
+                        "length must not drive a multi-GB allocation) "
+                        "[268435456]")
     p.add_argument("--inject-faults", default=None, metavar="SPEC",
                    help="Deterministic fault injection for testing "
                         "recovery paths: point@N[+],... with points "
                         "ingest, compute, device_oom, stall, "
-                        "device_hang, rank_death, write, journal "
+                        "device_hang, rank_death, write, journal, "
+                        "input_corrupt, disk_full, sigterm "
                         "(utils/faultinject.py; CCSX_FAULTS env "
                         "equivalent)")
     return p
@@ -412,6 +442,13 @@ def config_from_args(args) -> CcsConfig:
                   ">= 0 or a fraction in (0, 1), got "
                   f"{args.max_failed_holes!r}", file=sys.stderr)
             raise SystemExit(1)
+    max_record_bytes = getattr(args, "max_record_bytes", None)
+    if max_record_bytes is not None and max_record_bytes < 4096:
+        # a bound below any real record would reject every input; 4096
+        # still lets tests drive the oversize classification cheaply
+        print(f"Error: --max-record-bytes must be >= 4096, got "
+              f"{max_record_bytes}", file=sys.stderr)
+        raise SystemExit(1)
     return CcsConfig(
         min_subread_len=args.min_len,
         max_subread_len=args.max_len,
@@ -439,6 +476,9 @@ def config_from_args(args) -> CcsConfig:
         prep_threads=prep_threads,
         dispatch_deadline_s=dispatch_deadline,
         max_failed_holes=max_failed,
+        salvage=bool(getattr(args, "salvage", False)),
+        **({"max_record_bytes": max_record_bytes}
+           if max_record_bytes is not None else {}),
         **({"breaker_strikes": breaker_strikes}
            if breaker_strikes is not None else {}),
         **({"breaker_probe_s": breaker_probe}
@@ -503,7 +543,9 @@ def main(argv: Optional[list] = None) -> int:
         from ccsx_tpu.io import bamindex
 
         try:
-            idx = bamindex.build_index(args.input)
+            idx = bamindex.build_index(
+                args.input,
+                max_record_bytes=getattr(cfg, "max_record_bytes", 0))
         except (OSError, bam_mod.BamError) as e:
             print(f"Error: --make-index failed: {e}", file=sys.stderr)
             return 1

@@ -195,6 +195,20 @@ class CcsConfig:
     #   open re-probe interval for a tripped breaker (one group is
     #   dispatched as a probe; success closes the breaker).  0 = a
     #   tripped breaker stays open for the rest of the run
+    # ---- hostile-input ingest plane (io/corruption.py) ----
+    salvage: bool = False              # CLI --salvage: classified input
+    #   corruption (torn BGZF blocks, corrupt records, bad names,
+    #   truncated FASTQ — the pinned taxonomy) is booked + RESYNCED
+    #   past instead of killing the run: BGZF scans for the next valid
+    #   block header, BAM scans for the next plausible record, FASTA/Q
+    #   re-anchors on the next '>'/'@' line.  Off (default) = the
+    #   historical fail-fast rc-1, byte-identical.  Corrupt events
+    #   count into holes_corrupt, mark the run degraded, and feed the
+    #   --max-failed-holes budget
+    max_record_bytes: int = 256 * 1024 * 1024  # CLI --max-record-bytes:
+    #   allocation bound on one BAM alignment record, enforced BEFORE
+    #   allocating — a corrupt int32 length must not drive a multi-GB
+    #   allocation (both reader stacks, salvage on or off)
     max_failed_holes: Optional[float] = None  # CLI --max-failed-holes:
     #   quarantine budget — an integer count (>= 0, checked per
     #   failure) or a fraction of processed holes in (0, 1) (checked at

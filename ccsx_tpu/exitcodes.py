@@ -16,8 +16,16 @@ Codes:
   resume handled by recompute, refused merge (dead/mixed shards), bad
   flags, a shepherd rank exhausting its restart budget.
 * ``RC_FAILED_HOLES`` (2) — the --max-failed-holes budget was
-  exceeded: too many holes quarantined for the output to be worth
-  emitting as a "success" (the near-empty-FASTA-at-rc-0 trap).
+  exceeded: too many holes quarantined (or, under --salvage, lost to
+  input corruption) for the output to be worth emitting as a
+  "success" (the near-empty-FASTA-at-rc-0 trap).
+* ``RC_INTERRUPTED`` (75, EX_TEMPFAIL) — a graceful drain: the run
+  received SIGTERM/SIGINT, stopped admission, finished its in-flight
+  groups, flushed the writer and settled the journal, then exited.
+  The run is RESUMABLE: re-run the same command (with the same
+  --journal) and it continues to a byte-identical output.  75 is
+  sysexits' EX_TEMPFAIL ("temporary failure, retry"), which is
+  exactly the contract.
 * ``RC_INJECTED_KILL`` (57) — a fault-injection hard exit
   (utils/faultinject.py write/journal/rank_death points, os._exit);
   distinctive so tests and operators can tell an injected kill from a
@@ -29,5 +37,7 @@ from ccsx_tpu.utils.faultinject import EXIT_CODE as RC_INJECTED_KILL
 RC_OK = 0
 RC_FATAL = 1
 RC_FAILED_HOLES = 2
+RC_INTERRUPTED = 75
 
-__all__ = ["RC_OK", "RC_FATAL", "RC_FAILED_HOLES", "RC_INJECTED_KILL"]
+__all__ = ["RC_OK", "RC_FATAL", "RC_FAILED_HOLES", "RC_INTERRUPTED",
+           "RC_INJECTED_KILL"]

@@ -17,10 +17,15 @@ import gzip
 import io
 import os
 import struct
-from typing import Iterator
+import zlib
+from typing import Iterator, Optional
 
 import numpy as np
 
+from ccsx_tpu.io.corruption import (CorruptionError,
+                                    DEFAULT_MAX_RECORD_BYTES,
+                                    MIN_RECORD_BLOCK, SCAN_LOOKAHEAD,
+                                    SalvageSink, record_plausible)
 from ccsx_tpu.io.fastx import FastxRecord
 
 SEQ_NT16 = b"=ACMGRSVTWYHKDBN"
@@ -32,40 +37,97 @@ for _b in range(256):
     _NIB[_b, 1] = SEQ_NT16[_b & 0xF]
 
 
-class BamError(ValueError):
-    pass
+class BamError(CorruptionError):
+    """Classified BAM/BGZF parse failure (io/corruption.py taxonomy).
+
+    Subclasses CorruptionError(ValueError), so every pre-taxonomy
+    handler (``except BamError`` / ``except ValueError``) still works;
+    ``reason`` is the stable code both reader stacks report."""
+
+    def __init__(self, msg: str, reason: str = "bam_bad_record"):
+        super().__init__(reason, msg)
 
 
-def _read_exact(f, n: int, what: str) -> bytes:
+def check_record_length(block_size: int,
+                        max_record_bytes: int = 0) -> None:
+    """THE allocation-bound check on one alignment record's length
+    field, shared by the sequential reader and the byte-range sharded
+    reader (io/bamindex.py): reject BEFORE any read() allocates, with
+    the oversize-vs-corrupt reason split made in exactly one place."""
+    max_rec = max_record_bytes or DEFAULT_MAX_RECORD_BYTES
+    if not 32 <= block_size <= max_rec:
+        raise BamError(
+            f"corrupt BAM record length {block_size}"
+            + (f" (exceeds the --max-record-bytes bound {max_rec})"
+               if block_size > max_rec else ""),
+            "bam_record_oversize" if block_size > max_rec
+            else "bam_bad_record")
+
+
+def _read_exact(f, n: int, what: str,
+                reason: str = "bam_bad_record") -> bytes:
     buf = f.read(n)
     if len(buf) != n:
-        raise BamError(f"truncated BAM: short read in {what}")
+        raise BamError(f"truncated BAM: short read in {what}", reason)
     return buf
 
 
 def read_bam_header(f) -> dict:
-    magic = _read_exact(f, 4, "magic")
+    magic = _read_exact(f, 4, "magic", "bam_bad_header")
     if magic != b"BAM\x01":
-        raise BamError("invalid BAM header")  # bamlite.c:84
-    (l_text,) = struct.unpack("<i", _read_exact(f, 4, "l_text"))
-    text = _read_exact(f, l_text, "text").rstrip(b"\x00").decode(
-        errors="replace")
-    (n_ref,) = struct.unpack("<i", _read_exact(f, 4, "n_ref"))
+        raise BamError("invalid BAM header", "bam_bad_header")  # bamlite.c:84
+    (l_text,) = struct.unpack("<i",
+                              _read_exact(f, 4, "l_text", "bam_bad_header"))
+    # allocation bound: a corrupt length field must be rejected BEFORE
+    # the read allocates (a flipped high bit reads as multi-GB)
+    if not 0 <= l_text <= DEFAULT_MAX_RECORD_BYTES:
+        raise BamError(f"corrupt BAM header: l_text={l_text}",
+                       "bam_bad_header")
+    text = _read_exact(f, l_text, "text", "bam_bad_header").rstrip(
+        b"\x00").decode(errors="replace")
+    (n_ref,) = struct.unpack("<i",
+                             _read_exact(f, 4, "n_ref", "bam_bad_header"))
+    if not 0 <= n_ref <= 1 << 24:
+        raise BamError(f"corrupt BAM header: n_ref={n_ref}",
+                       "bam_bad_header")
     refs = []
     for _ in range(n_ref):
-        (l_name,) = struct.unpack("<i", _read_exact(f, 4, "ref name len"))
-        name = _read_exact(f, l_name, "ref name")[:-1].decode(errors="replace")
-        (l_ref,) = struct.unpack("<i", _read_exact(f, 4, "ref len"))
+        (l_name,) = struct.unpack(
+            "<i", _read_exact(f, 4, "ref name len", "bam_bad_header"))
+        if not 1 <= l_name <= 4096:
+            raise BamError(f"corrupt BAM header: ref name len={l_name}",
+                           "bam_bad_header")
+        name = _read_exact(f, l_name, "ref name",
+                           "bam_bad_header")[:-1].decode(errors="replace")
+        (l_ref,) = struct.unpack(
+            "<i", _read_exact(f, 4, "ref len", "bam_bad_header"))
         refs.append((name, l_ref))
     return {"text": text, "refs": refs}
 
 
-def read_bam_records(path_or_file, with_aux: bool = False):
+def read_bam_records(path_or_file, with_aux: bool = False,
+                     salvage: Optional[SalvageSink] = None,
+                     max_record_bytes: int = 0):
     """Stream BAM alignment records as FastxRecords (name/seq/qual).
 
     With ``with_aux``, yields (FastxRecord, aux_dict) pairs instead,
     where aux_dict is parse_aux of the record's tag region
-    (bamlite.c:215-290 equivalent; ccsx's hot path never reads tags)."""
+    (bamlite.c:215-290 equivalent; ccsx's hot path never reads tags).
+
+    ``salvage`` (a SalvageSink) selects salvage mode: classified
+    corruption is booked and RESYNCED past — BGZF block resync on
+    container damage, plausible-record scan on record damage
+    (io/corruption.py spec) — instead of raised.  Without it, the
+    historical fail-fast behavior is preserved byte-for-byte (the
+    first classified corruption raises BamError).  ``max_record_bytes``
+    (0 = DEFAULT_MAX_RECORD_BYTES) is the allocation bound on one
+    alignment record, enforced BEFORE allocating either way."""
+    max_rec = max_record_bytes or DEFAULT_MAX_RECORD_BYTES
+    if salvage is not None:
+        yield from _read_bam_salvage(path_or_file, with_aux, salvage,
+                                     max_record_bytes
+                                     or salvage.max_record_bytes)
+        return
     bgzf_path = None
     if hasattr(path_or_file, "read"):
         raw = path_or_file
@@ -102,7 +164,8 @@ def read_bam_records(path_or_file, with_aux: bool = False):
             fh.seek(max(0, size - len(BGZF_EOF)))
             if fh.read() != BGZF_EOF:
                 raise BamError("BGZF stream missing EOF marker "
-                               "(truncated at a block boundary?)")
+                               "(truncated at a block boundary?)",
+                               "bgzf_missing_eof")
 
     read_bam_header(f)
     while True:
@@ -113,6 +176,10 @@ def read_bam_records(path_or_file, with_aux: bool = False):
         if len(head) < 4:
             raise BamError("truncated BAM: partial block size")
         (block_size,) = struct.unpack("<i", head)
+        # bound BEFORE the read allocates: a corrupt int32 must not
+        # drive a multi-GB buffer (and a negative one would read(-1)
+        # the whole rest of the stream)
+        check_record_length(block_size, max_rec)
         block = _read_exact(f, block_size, "alignment block")
         rec, aux_buf = decode_record(block)
         if with_aux:
@@ -129,8 +196,19 @@ def decode_record(block: bytes):
     126 (seqio.h:113).  Shared by the sequential reader above and the
     byte-range sharded reader (io/bamindex.py) so the two streams can
     never diverge in decode semantics."""
+    if len(block) < 32:
+        raise BamError(f"corrupt BAM record: {len(block)}-byte block")
     (refid, pos, l_read_name, mapq, bin_, n_cigar, flag, l_seq,
      next_ref, next_pos, tlen) = struct.unpack("<iiBBHHHiiii", block[:32])
+    # field-consistency audit (the native reader makes the same checks,
+    # io_native.cpp BamReader::next): a corrupt length field must
+    # classify as bam_bad_record, not surface as a numpy bounds error
+    if (l_read_name < 1 or l_seq < 0
+            or 32 + l_read_name + 4 * n_cigar + (l_seq + 1) // 2 + l_seq
+            > len(block)):
+        raise BamError(
+            f"corrupt BAM record fields (l_read_name={l_read_name}, "
+            f"n_cigar={n_cigar}, l_seq={l_seq}, block={len(block)})")
     off = 32
     name = block[off:off + l_read_name - 1].decode(errors="replace")
     off += l_read_name
@@ -240,6 +318,337 @@ def aux2Z(aux: dict, tag: str):
     """String getter: Z/H else None (bam_aux2Z, bamlite.c:278-285)."""
     typ, val = _aux_tv(aux, tag)
     return val if typ in ("Z", "H") else None
+
+
+# ---- salvage-mode reading (io/corruption.py taxonomy + resync spec) ------
+#
+# Salvage mode degrades per-record, not per-file: classified corruption
+# books an event into the SalvageSink and the reader RESYNCS —
+#   * BGZF container damage: scan the raw file forward for the next
+#     valid block header (magic + BC subfield + a BSIZE that chains to
+#     another block header or EOF);
+#   * record damage (or the gap a skipped block leaves): scan the
+#     inflated stream for the next plausible record start
+#     (corruption.record_plausible — the contract io_native.cpp
+#     mirrors byte-for-byte, pinned by the differential fuzz tests).
+# Records that survive flow on unchanged; a hole that lost records
+# emits a consensus from its surviving passes (it is damaged either
+# way — the salvage invariant only constrains undamaged holes).
+
+_BGZF_MAGIC3 = b"\x1f\x8b\x08"
+
+
+def _read_bgzf_header(f, pos: int, size: int):
+    """(bsize, xlen, ok) for a candidate BGZF block header at file
+    offset ``pos``; bsize is the total on-disk block size.  Pure
+    structure check — shared by the salvage block walk and its resync
+    scan (and mirrored by io_native.cpp's read_raw/try_candidate)."""
+    if size - pos < 12:
+        return 0, 0, False
+    f.seek(pos)
+    head = f.read(12)
+    if len(head) < 12 or head[:3] != _BGZF_MAGIC3 or not head[3] & 4:
+        return 0, 0, False
+    (xlen,) = struct.unpack_from("<H", head, 10)
+    extra = f.read(xlen)
+    if len(extra) < xlen:
+        return 0, xlen, False
+    i = 0
+    while i + 4 <= xlen:
+        (slen,) = struct.unpack_from("<H", extra, i + 2)
+        if extra[i:i + 2] == b"BC" and slen == 2 and i + 6 <= xlen:
+            (bs,) = struct.unpack_from("<H", extra, i + 4)
+            bsize = bs + 1
+            if bsize >= 12 + xlen + 8:
+                return bsize, xlen, True
+            return 0, xlen, False
+        i += 4 + slen
+    return 0, xlen, False
+
+
+def _bgzf_salvage_chunks(path: str, sink: SalvageSink):
+    """Yield (inflated_block_bytes, gap_before) over a possibly-damaged
+    BGZF file, STREAMING — O(one block) of memory, never the whole file
+    (salvage exists for production-sized inputs).  Container damage
+    books one event per resync region: header damage -> bgzf_bad_block
+    + forward scan for the next valid chained header; payload damage ->
+    bgzf_bad_deflate + skip the block; truncation -> bgzf_torn_tail;
+    a missing EOF marker -> bgzf_missing_eof (degrades but is
+    budget-exempt: no hole is provably lost)."""
+    with open(path, "rb") as f:
+        f.seek(0, 2)
+        size = f.tell()
+        pos = 0
+        gap = False
+        last_was_eof_marker = False
+
+        def try_candidate(cand: int) -> bool:
+            """Valid chained header at cand: its BSIZE lands exactly on
+            EOF or on another block magic (the header-integrity check
+            BGZF itself lacks)."""
+            bsize, _, ok = _read_bgzf_header(f, cand, size)
+            if not ok or cand + bsize > size:
+                return False
+            if cand + bsize == size:
+                return True
+            f.seek(cand + bsize)
+            return f.read(3) == _BGZF_MAGIC3
+
+        def rescan(start: int) -> int:
+            """Next offset > start holding a valid chained block
+            header, or -1 — a windowed forward scan (2-byte overlap so
+            a magic spanning two windows is still seen)."""
+            o = start + 1
+            while o + 12 <= size:
+                f.seek(o)
+                win = f.read(1 << 16)
+                if len(win) < 3:
+                    break
+                j = win.find(_BGZF_MAGIC3)
+                while j != -1:
+                    if try_candidate(o + j):
+                        return o + j
+                    j = win.find(_BGZF_MAGIC3, j + 1)
+                o += max(len(win) - 2, 1)
+            return -1
+
+        while pos < size:
+            bsize, xlen, ok = _read_bgzf_header(f, pos, size)
+            if not ok or pos + bsize > size:
+                # header damage (or a block running past EOF = torn tail)
+                sink.record("bgzf_torn_tail" if ok or size - pos < 12
+                            else "bgzf_bad_block")
+                last_was_eof_marker = False
+                nxt = rescan(pos)
+                if nxt == -1:
+                    break
+                pos, gap = nxt, True
+                continue
+            f.seek(pos + 12 + xlen)
+            payload = f.read(bsize - 12 - xlen - 8)
+            crc, isize = struct.unpack("<II", f.read(8))
+            last_was_eof_marker = len(payload) <= 4 and isize == 0
+            if isize > 1 << 16:
+                # BGZF caps the uncompressed block at 64KB; a larger
+                # ISIZE is a payload lie — reject before allocating
+                sink.record("bgzf_bad_deflate")
+                pos, gap = pos + bsize, True
+                continue
+            try:
+                data = zlib.decompress(payload, -15)
+            except zlib.error:
+                data = None
+            if (data is None or len(data) != isize
+                    or zlib.crc32(data) != crc):
+                sink.record("bgzf_bad_deflate")
+                pos, gap = pos + bsize, True
+                continue
+            pos += bsize
+            if data:
+                yield data, gap
+                gap = False
+        if not last_was_eof_marker:
+            sink.record("bgzf_missing_eof")
+
+
+def _gzip_salvage_chunks(f, sink: SalvageSink, own: bool = False):
+    """Yield (chunk, False) from a plain-gzip (or raw) stream; a
+    corrupt/truncated deflate stream has no block structure to resync
+    on, so it books one gzip_truncated and ends the stream — the
+    records already delivered are the salvage.  ``own``: this
+    generator opened the handle and closes it at exhaustion."""
+    try:
+        while True:
+            try:
+                data = f.read(1 << 16)
+            except (OSError, EOFError, zlib.error):
+                sink.record("gzip_truncated")
+                return
+            if not data:
+                return
+            yield data, False
+    finally:
+        if own:
+            try:
+                f.close()
+            except OSError:
+                pass
+
+
+class _SalvageFeed:
+    """Byte feed over a (chunk, gap_before) iterator with explicit gap
+    surfacing: bytes on the two sides of a gap must never be parsed as
+    one contiguous record."""
+
+    def __init__(self, chunks):
+        self._it = iter(chunks)
+        self.buf = bytearray()
+        self.pos = 0
+        self._queued = None   # post-gap chunk awaiting take_gap()
+        self.eof = False
+
+    def ensure(self, n: int) -> str:
+        """'ok' when n bytes are available at pos; 'gap' when a gap
+        interrupts first (call take_gap()); 'eof' at stream end."""
+        while len(self.buf) - self.pos < n:
+            if self._queued is not None:
+                return "gap"
+            if self.eof:
+                return "eof"
+            try:
+                data, gap = next(self._it)
+            except StopIteration:
+                self.eof = True
+                return "eof"
+            if gap:
+                self._queued = data
+                return "gap"
+            self.buf += data
+        return "ok"
+
+    def take_gap(self) -> None:
+        """Discard the unconsumed pre-gap tail (bytes of a damaged
+        record) and absorb the post-gap chunk."""
+        del self.buf[self.pos:]
+        if self._queued is not None:
+            self.buf += self._queued
+            self._queued = None
+
+    def avail(self) -> int:
+        return len(self.buf) - self.pos
+
+    def compact(self) -> None:
+        if self.pos > 1 << 16:
+            del self.buf[:self.pos]
+            self.pos = 0
+
+
+def _salvage_scan(feed: _SalvageFeed, max_rec: int) -> str:
+    """Advance feed.pos to the next plausible record start ('ok'), or
+    consume the tail and report 'eof'.  One byte per rejection — the
+    exact scan io_native.cpp mirrors."""
+    while True:
+        st = feed.ensure(SCAN_LOOKAHEAD)
+        if st == "gap":
+            feed.take_gap()
+            continue
+        if st == "eof" and feed.avail() < 36:
+            feed.pos = len(feed.buf)
+            return "eof"
+        if record_plausible(feed.buf, feed.pos, max_rec):
+            return "ok"
+        feed.pos += 1
+        feed.compact()
+
+
+def _salvage_header(feed: _SalvageFeed) -> bool:
+    """Tolerant BAM-header parse over the feed; False = damaged (the
+    caller falls back to the record scan)."""
+    if feed.ensure(12) != "ok" or bytes(feed.buf[feed.pos:feed.pos + 4]) \
+            != b"BAM\x01":
+        return False
+    (l_text,) = struct.unpack_from("<i", feed.buf, feed.pos + 4)
+    if not 0 <= l_text <= DEFAULT_MAX_RECORD_BYTES:
+        return False
+    if feed.ensure(12 + l_text) != "ok":
+        return False
+    (n_ref,) = struct.unpack_from("<i", feed.buf, feed.pos + 8 + l_text)
+    if not 0 <= n_ref <= 1 << 24:
+        return False
+    feed.pos += 12 + l_text
+    for _ in range(n_ref):
+        if feed.ensure(4) != "ok":
+            return False
+        (l_name,) = struct.unpack_from("<i", feed.buf, feed.pos)
+        if not 1 <= l_name <= 4096:
+            return False
+        if feed.ensure(8 + l_name) != "ok":
+            return False
+        feed.pos += 8 + l_name
+    return True
+
+
+def _read_bam_salvage(path_or_file, with_aux: bool, sink: SalvageSink,
+                      max_rec: int = 0):
+    """The salvage-mode record stream: block-resynced BGZF chunks (real
+    paths) or a classified plain-gzip stream, walked with the shared
+    plausible-record scan."""
+    max_rec = max_rec or sink.max_record_bytes
+    if isinstance(path_or_file, (str, os.PathLike)) \
+            and os.path.exists(str(path_or_file)):
+        with open(path_or_file, "rb") as fh:
+            head = fh.read(14)
+        if (len(head) >= 14 and head[:3] == _BGZF_MAGIC3
+                and head[3] & 4 and head[12:14] == b"BC"):
+            chunks = _bgzf_salvage_chunks(str(path_or_file), sink)
+        else:
+            raw = open(path_or_file, "rb")
+            if head[:2] == b"\x1f\x8b":
+                raw = io.BufferedReader(gzip.GzipFile(fileobj=raw))
+            chunks = _gzip_salvage_chunks(raw, sink, own=True)
+    else:
+        raw = path_or_file
+        if not hasattr(raw, "peek"):
+            raw = io.BufferedReader(raw)
+        if raw.peek(2)[:2] == b"\x1f\x8b":
+            raw = io.BufferedReader(gzip.GzipFile(fileobj=raw))
+        chunks = _gzip_salvage_chunks(raw, sink)
+
+    feed = _SalvageFeed(chunks)
+    resync = False
+    if not _salvage_header(feed):
+        sink.record("bam_bad_header")
+        resync = True
+    while True:
+        feed.compact()
+        if resync:
+            if _salvage_scan(feed, max_rec) == "eof":
+                return
+            resync = False
+        st = feed.ensure(4)
+        if st == "gap":
+            feed.take_gap()
+            resync = True
+            continue
+        if st == "eof":
+            if feed.avail():
+                sink.record("bam_bad_record")
+                feed.pos = len(feed.buf)
+            return
+        (block_size,) = struct.unpack_from("<i", feed.buf, feed.pos)
+        if not MIN_RECORD_BLOCK <= block_size <= max_rec:
+            sink.record("bam_record_oversize"
+                        if block_size > max_rec else "bam_bad_record")
+            feed.pos += 1
+            resync = True
+            continue
+        st = feed.ensure(4 + block_size)
+        if st == "gap":
+            feed.take_gap()
+            resync = True
+            continue
+        if st == "eof":
+            sink.record("bam_bad_record")
+            feed.pos = len(feed.buf)
+            return
+        block = bytes(feed.buf[feed.pos + 4:feed.pos + 4 + block_size])
+        try:
+            rec, aux_buf = decode_record(block)
+        except (BamError, ValueError):
+            sink.record("bam_bad_record")
+            feed.pos += 1
+            resync = True
+            continue
+        feed.pos += 4 + block_size
+        if with_aux:
+            try:
+                aux = parse_aux(aux_buf)
+            except BamError:
+                sink.record("bam_bad_record")
+                aux = {}
+            yield rec, aux
+        else:
+            yield rec
 
 
 # BGZF framing (the real subreads.bam container): gzip members <=64KB

@@ -39,7 +39,8 @@ import struct
 import zlib
 from typing import Iterator, List, Optional, Tuple
 
-from ccsx_tpu.io.bam import BamError, read_bam_header
+from ccsx_tpu.io.bam import (BamError, check_record_length,
+                             read_bam_header)
 from ccsx_tpu.io.fastx import FastxRecord
 
 INDEX_SUFFIX = ".ccsx_idx"
@@ -72,7 +73,7 @@ class BgzfBlockReader:
             return False
         if len(head) < 18 or head[:4] != b"\x1f\x8b\x08\x04":
             raise BamError("not a BGZF block (sharded ingest requires "
-                           "a real BGZF container)")
+                           "a real BGZF container)", "bgzf_bad_block")
         (xlen,) = struct.unpack_from("<H", head, 10)
         extra = head[12:18]
         # walk the extra subfields for BC (usually first)
@@ -87,16 +88,25 @@ class BgzfBlockReader:
                 break
             off += 4 + slen
         if bsize is None:
-            raise BamError("BGZF block missing BC subfield")
+            raise BamError("BGZF block missing BC subfield",
+                           "bgzf_bad_block")
         payload_len = bsize + 1 - 12 - xlen - 8
+        if payload_len < 0:
+            raise BamError(f"BGZF block BSIZE {bsize} smaller than its "
+                           "own header", "bgzf_bad_block")
         comp = self._f.read(payload_len)
         tail = self._f.read(8)
         if len(comp) < payload_len or len(tail) < 8:
-            raise BamError("truncated BGZF block")
-        data = zlib.decompress(comp, -15)
+            raise BamError("truncated BGZF block", "bgzf_torn_tail")
+        try:
+            data = zlib.decompress(comp, -15)
+        except zlib.error as e:
+            raise BamError(f"BGZF block inflate failed: {e}",
+                           "bgzf_bad_deflate") from None
         crc, isize = struct.unpack("<II", tail)
         if isize != len(data) & 0xFFFFFFFF or zlib.crc32(data) != crc:
-            raise BamError("BGZF block CRC/ISIZE mismatch")
+            raise BamError("BGZF block CRC/ISIZE mismatch",
+                           "bgzf_bad_deflate")
         self.compressed_bytes += bsize + 1
         if data:
             self._spans.append(
@@ -155,7 +165,8 @@ def _hole_key(name: str) -> Tuple[str, str]:
     return (parts[0], parts[1]) if len(parts) >= 2 else (name, "")
 
 
-def _records_with_boundaries(r: BgzfBlockReader):
+def _records_with_boundaries(r: BgzfBlockReader,
+                             max_record_bytes: int = 0):
     """Yield (voffset_before_record, name) for each alignment record.
 
     Only the name is decoded — the indexing pass does not touch seq or
@@ -168,6 +179,8 @@ def _records_with_boundaries(r: BgzfBlockReader):
         if len(head) < 4:
             raise BamError("truncated BAM: partial block size")
         (block_size,) = struct.unpack("<i", head)
+        # allocation bound, shared classify-split (io/bam.py)
+        check_record_length(block_size, max_record_bytes)
         block = r.read(block_size)
         if len(block) < block_size:
             raise BamError("truncated BAM: short alignment block")
@@ -176,7 +189,8 @@ def _records_with_boundaries(r: BgzfBlockReader):
         yield voff, name
 
 
-def build_index(path: str, every: int = 64) -> dict:
+def build_index(path: str, every: int = 64,
+                max_record_bytes: int = 0) -> dict:
     """Index a BGZF BAM's hole boundaries; writes ``<path>.ccsx_idx``.
 
     Entries: [raw_hole_ordinal, coffset, uoffset] for every ``every``-th
@@ -189,7 +203,7 @@ def build_index(path: str, every: int = 64) -> dict:
         n_holes = 0
         n_records = 0
         prev_key = None
-        for voff, name in _records_with_boundaries(r):
+        for voff, name in _records_with_boundaries(r, max_record_bytes):
             key = _hole_key(name)
             if key != prev_key:
                 if n_holes % every == 0:
@@ -232,7 +246,8 @@ def hole_range(n_holes: int, rank: int, n: int) -> Tuple[int, int]:
 
 
 def read_hole_range(path: str, idx: dict, lo: int, hi: int,
-                    counter=None) -> Iterator[FastxRecord]:
+                    counter=None,
+                    max_record_bytes: int = 0) -> Iterator[FastxRecord]:
     """Stream the records of raw holes [lo, hi) as FastxRecords.
 
     Seeks to the nearest indexed boundary <= lo (at most ``every``-1
@@ -263,7 +278,8 @@ def read_hole_range(path: str, idx: dict, lo: int, hi: int,
         holes_seen = base_ord - 1   # ordinal of prev_key's hole
         prev_key = None
         try:
-            yield from _range_records(r, lo, hi, holes_seen, prev_key)
+            yield from _range_records(r, lo, hi, holes_seen, prev_key,
+                                      max_record_bytes)
         finally:
             # fires even when the consumer abandons the generator, so
             # metrics.ingest_bytes is counted for partial consumption
@@ -271,7 +287,8 @@ def read_hole_range(path: str, idx: dict, lo: int, hi: int,
                 counter(r.compressed_bytes)
 
 
-def _range_records(r, lo, hi, holes_seen, prev_key):
+def _range_records(r, lo, hi, holes_seen, prev_key,
+                   max_record_bytes: int = 0):
     from ccsx_tpu.io.bam import decode_record
 
     while True:
@@ -281,6 +298,7 @@ def _range_records(r, lo, hi, holes_seen, prev_key):
         if len(head) < 4:
             raise BamError("truncated BAM: partial block size")
         (block_size,) = struct.unpack("<i", head)
+        check_record_length(block_size, max_record_bytes)
         block = r.read(block_size)
         if len(block) < block_size:
             raise BamError("truncated BAM: short alignment block")

@@ -12,7 +12,16 @@ from __future__ import annotations
 import dataclasses
 import gzip
 import io
+import zlib
 from typing import Iterator, Optional, Union
+
+from ccsx_tpu.io.corruption import CorruptionError
+
+
+class FastxError(CorruptionError):
+    """Classified FASTA/FASTQ parse failure (io/corruption.py
+    taxonomy); subclasses CorruptionError(ValueError), so pre-taxonomy
+    ``except ValueError`` handlers still work."""
 
 
 @dataclasses.dataclass
@@ -54,9 +63,36 @@ def _open(path_or_file) -> io.BufferedReader:
     return f
 
 
-def read_fastx(path_or_file) -> Iterator[FastxRecord]:
-    """Stream records from a FASTA/FASTQ file (gzip transparent)."""
+class _SalvageLines:
+    """readline() wrapper that classifies a corrupt/truncated gzip
+    stream into the salvage sink (the rest of a broken deflate stream
+    is unrecoverable — no block structure to resync on) instead of
+    raising mid-parse."""
+
+    def __init__(self, f, sink):
+        self._f = f
+        self._sink = sink
+
+    def readline(self) -> bytes:
+        try:
+            return self._f.readline()
+        except (OSError, EOFError, zlib.error):
+            self._sink.record("gzip_truncated")
+            return b""
+
+
+def read_fastx(path_or_file, salvage=None) -> Iterator[FastxRecord]:
+    """Stream records from a FASTA/FASTQ file (gzip transparent).
+
+    ``salvage`` (a corruption.SalvageSink) selects salvage mode: a
+    classified corruption — FASTQ quality/sequence length mismatch,
+    stream truncation — books an event and the parser RESYNCS to the
+    next line starting with '>'/'@' (the same line-anchored resync the
+    native reader implements) instead of raising.  Without it, the
+    historical fail-fast raise is preserved."""
     f = _open(path_or_file)
+    if salvage is not None:
+        f = _SalvageLines(f, salvage)
     line = f.readline()
     # skip leading junk until a record marker (kseq skips to '>'/'@')
     while line and line[:1] not in (b">", b"@"):
@@ -89,7 +125,19 @@ def read_fastx(path_or_file) -> Iterator[FastxRecord]:
                 line = f.readline()
             qual = b"".join(qual_parts)
             if len(qual) != len(seq):
-                raise ValueError(
+                if salvage is not None:
+                    # shorter = the stream ended under the record
+                    # (truncation); longer = a damaged quality section.
+                    # Book it, drop the record, resync to the next
+                    # '>'/'@' line anchor (fastx.py:61 primitive)
+                    salvage.record("fastx_truncated"
+                                   if len(qual) < len(seq)
+                                   else "fastx_qual_mismatch")
+                    while line and line[:1] not in (b">", b"@"):
+                        line = f.readline()
+                    continue
+                raise FastxError(
+                    "fastx_qual_mismatch",
                     f"FASTQ record {name}: quality length {len(qual)} != "
                     f"sequence length {len(seq)}"
                 )

@@ -19,12 +19,18 @@ from typing import Iterable, Iterator, List, Optional
 import numpy as np
 
 from ccsx_tpu.config import CcsConfig
+from ccsx_tpu.io.corruption import CorruptionError
 from ccsx_tpu.io.fastx import FastxRecord
 from ccsx_tpu.utils import trace
 
 
-class InvalidZmwName(ValueError):
-    pass
+class InvalidZmwName(CorruptionError):
+    """Malformed movie/hole/region subread name — classified
+    ``zmw_bad_name`` in the corruption taxonomy (io/corruption.py);
+    still a ValueError for pre-taxonomy handlers."""
+
+    def __init__(self, msg: str):
+        super().__init__("zmw_bad_name", msg)
 
 
 @dataclasses.dataclass
@@ -58,12 +64,26 @@ def split_name(name: str) -> tuple:
     return fields[0], fields[1], fields[2]
 
 
-def group_zmws(records: Iterable[FastxRecord]) -> Iterator[Zmw]:
-    """Group consecutive records by (movie, hole) into Zmw objects."""
+def group_zmws(records: Iterable[FastxRecord],
+               salvage=None) -> Iterator[Zmw]:
+    """Group consecutive records by (movie, hole) into Zmw objects.
+
+    A malformed name kills the whole stream by default (reference
+    parity, seqio.h:168-172); with ``salvage`` (a
+    corruption.SalvageSink) the poisoned record is dropped and booked
+    as ``zmw_bad_name``, and grouping re-anchors on the next record —
+    the hole the record truly belonged to emits from its surviving
+    passes (the native streamer applies the same rule in-library)."""
     cur_key = None
     cur_seqs: List[bytes] = []
     for rec in records:
-        movie, hole, _region = split_name(rec.name)
+        try:
+            movie, hole, _region = split_name(rec.name)
+        except InvalidZmwName:
+            if salvage is None:
+                raise
+            salvage.record("zmw_bad_name")
+            continue
         key = (movie, hole)
         if cur_key is None:
             cur_key, cur_seqs = key, [rec.seq]
@@ -107,8 +127,8 @@ def zmw_filter(zmw: Zmw, cfg: CcsConfig) -> bool:
 
 
 def stream_zmws(records: Iterable[FastxRecord], cfg: CcsConfig,
-                metrics=None) -> Iterator[Zmw]:
-    for z in group_zmws(records):
+                metrics=None, salvage=None) -> Iterator[Zmw]:
+    for z in group_zmws(records, salvage=salvage):
         reason = filter_reason(z, cfg)
         if reason is None:
             yield z

@@ -63,6 +63,30 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             continue
         fn.restype = None
         fn.argtypes = [c.c_void_p] + [c.POINTER(c.c_int64)] * 3
+    # salvage-mode ingest (same stale-.so guard: native/io.py falls
+    # back to the pure-Python salvage readers when these are absent)
+    try:
+        lib.ccsx_set_salvage.restype = None
+        lib.ccsx_set_salvage.argtypes = [c.c_void_p, c.c_int, c.c_int64]
+        lib.ccsx_prefetch_open_s.restype = c.c_void_p
+        lib.ccsx_prefetch_open_s.argtypes = [
+            c.c_char_p, c.c_int, c.c_int32, c.c_int64, c.c_int64,
+            c.c_int32, c.c_int, c.c_int64]
+        for name in ("ccsx_error_reason", "ccsx_prefetch_error_reason",
+                     "ccsx_corrupt_summary",
+                     "ccsx_prefetch_corrupt_summary"):
+            fn = getattr(lib, name)
+            fn.restype = c.c_char_p
+            fn.argtypes = [c.c_void_p]
+        for name in ("ccsx_corrupt_events",
+                     "ccsx_prefetch_corrupt_events",
+                     "ccsx_corrupt_exempt",
+                     "ccsx_prefetch_corrupt_exempt"):
+            fn = getattr(lib, name)
+            fn.restype = c.c_int64
+            fn.argtypes = [c.c_void_p]
+    except AttributeError:
+        pass
     lib.ccsx_close.restype = None
     lib.ccsx_close.argtypes = [c.c_void_p]
     for name in ("ccsx_encode", "ccsx_revcomp_ascii", "ccsx_revcomp_codes"):

@@ -15,14 +15,36 @@ from typing import Iterator, Optional
 import numpy as np
 
 from ccsx_tpu.config import CcsConfig
+from ccsx_tpu.io.corruption import CorruptionError
 from ccsx_tpu.io.fastx import FastxRecord
 from ccsx_tpu.io.zmw import InvalidZmwName, Zmw
 from ccsx_tpu import native
 from ccsx_tpu.utils import trace
 
 
-class NativeStreamError(ValueError):
-    pass
+class NativeStreamError(CorruptionError):
+    """Stream error surfaced by the native reader, carrying the stable
+    taxonomy code the C++ side classified it with (io/corruption.py)."""
+
+    def __init__(self, msg: str, reason: str = "bam_bad_record"):
+        super().__init__(reason or "bam_bad_record", msg)
+
+
+def salvage_supported() -> bool:
+    """True when the loaded native library exports the salvage entry
+    points (a stale prebuilt .so degrades to the Python salvage
+    readers, never to a load failure)."""
+    L = native.lib()
+    return L is not None and hasattr(L, "ccsx_set_salvage") \
+        and hasattr(L, "ccsx_prefetch_open_s")
+
+
+def _reason(L, h, fn_name: str) -> str:
+    fn = getattr(L, fn_name, None)
+    if fn is None:
+        return ""
+    val = fn(h)
+    return val.decode() if val else ""
 
 
 def _open(path: str, is_bam: bool):
@@ -71,9 +93,16 @@ def stream_zmws_native(path: str, cfg: CcsConfig,
     L, h = _open(path, cfg.is_bam)
     L.ccsx_set_filter(h, cfg.min_pass_count, cfg.min_subread_len,
                       cfg.max_subread_len)
+    if hasattr(L, "ccsx_set_salvage"):
+        # the --max-record-bytes allocation bound applies salvage ON OR
+        # OFF; on=1 additionally enables the resync behavior
+        L.ccsx_set_salvage(h, 1 if getattr(cfg, "salvage", False) else 0,
+                           getattr(cfg, "max_record_bytes", 0) or 0)
     return _zmw_gen(h, cfg, L.ccsx_next_zmw, L.ccsx_error, L.ccsx_close,
                     counts_fn=getattr(L, "ccsx_filter_counts", None),
-                    metrics=metrics)
+                    metrics=metrics, reason_fn_name="ccsx_error_reason",
+                    corrupt_fns=("ccsx_corrupt_events",
+                                 "ccsx_corrupt_summary"))
 
 
 def _surface_filter_counts(h, counts_fn, excluded: int, metrics) -> None:
@@ -109,28 +138,90 @@ def _surface_filter_counts(h, counts_fn, excluded: int, metrics) -> None:
                   **buckets)
 
 
+def _surface_corrupt_counts(L, h, summary_fn_name: str, metrics,
+                            prebooked: dict) -> None:
+    """At stream EOF, fold the native salvage accounting's per-reason
+    buckets into Metrics (the live event total was already polled per
+    yield — the full reason breakdown waits for EOF, where the C side
+    can summarize it race-free).  ``prebooked`` holds reasons already
+    booked live (the budget-exempt ones, polled via their own atomic so
+    --max-failed-holes math stays exact mid-stream) — subtracted here
+    so they are not double-counted."""
+    summary = _reason(L, h, summary_fn_name)
+    if not summary or metrics is None:
+        return
+    with metrics._count_lock:
+        for item in summary.split(","):
+            reason, _, count = item.partition(":")
+            if reason and count:
+                n = int(count) - prebooked.get(reason, 0)
+                if n:
+                    metrics.corrupt_reasons[reason] = (
+                        metrics.corrupt_reasons.get(reason, 0) + n)
+
+
 def _zmw_gen(h, cfg: CcsConfig, next_fn, error_fn, close_fn,
-             counts_fn=None, metrics=None) -> Iterator[Zmw]:
+             counts_fn=None, metrics=None, reason_fn_name="",
+             corrupt_fns=(None, None)) -> Iterator[Zmw]:
     """Shared drain loop for both native streamers (plain and prefetching)."""
     c = ctypes
+    L = native.lib()
     movie, hole = c.c_char_p(), c.c_char_p()
     seqs = c.POINTER(c.c_uint8)()
     total = c.c_int64()
     lens = c.POINTER(c.c_int32)()
     n = c.c_int32()
     excluded = 0
+    events_fn = getattr(L, corrupt_fns[0], None) \
+        if getattr(cfg, "salvage", False) and corrupt_fns[0] else None
+    exempt_fn = getattr(L, corrupt_fns[0].replace("_events", "_exempt"),
+                        None) if events_fn is not None else None
+    corrupt_seen = 0
+    exempt_seen = 0
+
+    def poll_corrupt():
+        # live salvage accounting: the event total is an atomic the C
+        # side bumps as it classifies; full per-reason buckets land at
+        # EOF.  Budget-EXEMPT events (bgzf_missing_eof) ride their own
+        # atomic and are booked into corrupt_reasons immediately, so a
+        # --max-failed-holes check on holes yielded after the event
+        # cannot misread a zero-loss degradation as a lost hole
+        nonlocal corrupt_seen, exempt_seen
+        if events_fn is None:
+            return
+        ev = int(events_fn(h))
+        ex = int(exempt_fn(h)) if exempt_fn is not None else 0
+        if ev > corrupt_seen:
+            if metrics is not None:
+                metrics.bump(holes_corrupt=ev - corrupt_seen)
+                if ex > exempt_seen:
+                    with metrics._count_lock:
+                        metrics.corrupt_reasons["bgzf_missing_eof"] = (
+                            metrics.corrupt_reasons.get(
+                                "bgzf_missing_eof", 0)
+                            + (ex - exempt_seen))
+                if not metrics.degraded:
+                    metrics.degraded = "input corruption (salvaged)"
+            corrupt_seen = ev
+            exempt_seen = max(exempt_seen, ex)
     try:
         while True:
             rc = next_fn(h, c.byref(movie), c.byref(hole),
                          c.byref(seqs), c.byref(total),
                          c.byref(lens), c.byref(n))
+            poll_corrupt()
             if rc == -1:
                 _surface_filter_counts(h, counts_fn, excluded, metrics)
+                if events_fn is not None and corrupt_fns[1]:
+                    _surface_corrupt_counts(
+                        L, h, corrupt_fns[1], metrics,
+                        {"bgzf_missing_eof": exempt_seen})
                 return
             if rc == -2:
                 raise InvalidZmwName(error_fn(h).decode())
             if rc < 0:
-                raise NativeStreamError(error_fn(h).decode())
+                raise NativeStreamError(error_fn(h).decode(),
+                                        _reason(L, h, reason_fn_name))
             hole_s = hole.value.decode()
             if cfg.exclude_holes and hole_s in cfg.exclude_holes:
                 excluded += 1
@@ -159,16 +250,28 @@ def stream_zmws_prefetch(path: str, cfg: CcsConfig,
     L = native.lib()
     if L is None:
         raise RuntimeError("native IO library unavailable")
-    h = L.ccsx_prefetch_open(path.encode(), 1 if cfg.is_bam else 0,
-                             cfg.min_pass_count, cfg.min_subread_len,
-                             cfg.max_subread_len, queue_cap)
+    if hasattr(L, "ccsx_prefetch_open_s"):
+        # the salvage-capable open also carries the --max-record-bytes
+        # bound, which applies salvage on or off
+        h = L.ccsx_prefetch_open_s(
+            path.encode(), 1 if cfg.is_bam else 0, cfg.min_pass_count,
+            cfg.min_subread_len, cfg.max_subread_len, queue_cap,
+            1 if getattr(cfg, "salvage", False) else 0,
+            getattr(cfg, "max_record_bytes", 0) or 0)
+    else:
+        h = L.ccsx_prefetch_open(path.encode(), 1 if cfg.is_bam else 0,
+                                 cfg.min_pass_count, cfg.min_subread_len,
+                                 cfg.max_subread_len, queue_cap)
     if not h:
         raise OSError(f"cannot open {path!r}")
     return _zmw_gen(h, cfg, L.ccsx_prefetch_next, L.ccsx_prefetch_error,
                     L.ccsx_prefetch_close,
                     counts_fn=getattr(L, "ccsx_prefetch_filter_counts",
                                       None),
-                    metrics=metrics)
+                    metrics=metrics,
+                    reason_fn_name="ccsx_prefetch_error_reason",
+                    corrupt_fns=("ccsx_prefetch_corrupt_events",
+                                 "ccsx_prefetch_corrupt_summary"))
 
 
 class NativeFastaWriter:

@@ -32,6 +32,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <deque>
+#include <map>
 #include <memory>
 #include <mutex>
 #include <string>
@@ -41,6 +42,51 @@
 namespace {
 
 constexpr int64_t kBufSize = 1 << 16;
+
+// ---- corruption taxonomy + salvage accounting ----------------------------
+//
+// Mirrors io/corruption.py: the reason codes, the allocation bound, the
+// BGZF block-resync rules, and the plausible-record scan are a shared
+// contract — the differential fuzz tests (tests/test_fuzz_ingest.py)
+// hold the two stacks to the same classification and the same salvaged
+// record set on the same mutant.
+
+constexpr int64_t kDefaultMaxRecordBytes = 256LL * 1024 * 1024;
+constexpr int64_t kMinRecordBlock = 34;     // 32 fixed + 2-byte name
+constexpr int64_t kScanLookahead = 4 + 32 + 255;
+
+struct Salvage {
+  bool on = false;
+  int64_t max_record_bytes = kDefaultMaxRecordBytes;
+  // events/exempt are read live across the ctypes boundary (the
+  // prefetch consumer polls while the producer parses) — atomic; the
+  // full reason buckets are only summarized after EOF.  exempt counts
+  // the budget-exempt reasons (corruption.NON_BUDGET_REASONS — today
+  // only bgzf_missing_eof) so a --max-failed-holes check on holes
+  // yielded AFTER the event but BEFORE EOF cannot misread a zero-loss
+  // degradation as a lost hole.
+  std::atomic<int64_t> events{0};
+  std::atomic<int64_t> exempt{0};
+  std::map<std::string, int64_t> counts;
+  std::string summary;   // built by build_summary(), owned here
+
+  void record(const char* reason) {
+    events.fetch_add(1, std::memory_order_relaxed);
+    if (std::strcmp(reason, "bgzf_missing_eof") == 0)
+      exempt.fetch_add(1, std::memory_order_relaxed);
+    counts[reason]++;
+  }
+  const char* build_summary() {
+    summary.clear();
+    for (const auto& kv : counts) {
+      if (!summary.empty()) summary.push_back(',');
+      summary += kv.first;
+      summary.push_back(':');
+      summary += std::to_string(kv.second);
+    }
+    return summary.c_str();
+  }
+};
 
 // ---- decode tables -------------------------------------------------------
 
@@ -89,11 +135,18 @@ struct BgzfMT {
     std::vector<uint8_t> out;
     uint32_t crc = 0, isize = 0;
     bool done = false, bad = false;
+    bool gap_before = false;     // salvage: dropped bytes precede this
   };
 
   FILE* f = nullptr;
   bool raw_eof = false, err = false;
+  const char* err_reason = nullptr;  // taxonomy code for err (fail-fast)
   bool last_was_eof_marker = false;  // saw the 28-byte empty EOF block
+  Salvage* sv = nullptr;             // non-null + on = salvage mode
+  long file_size = -1;               // computed lazily for salvage scans
+  bool gap_pending = false;          // skipped data since the last job
+  bool pending_gap_out = false;      // job dropped at delivery time
+  bool end_counted = false;          // torn-tail end event booked once
   int nthreads = 1;
   size_t depth = 64;                         // blocks in flight
   std::deque<std::shared_ptr<Job>> order;    // file order
@@ -172,21 +225,31 @@ struct BgzfMT {
     }
   }
 
-  // parse one raw BGZF member from f; null at EOF (err set on a
-  // malformed header/truncation)
-  std::shared_ptr<Job> read_raw() {
-    uint8_t hdr[12];
-    size_t n = fread(hdr, 1, 12, f);
-    if (n == 0) { raw_eof = true; return nullptr; }
-    if (n != 12 || hdr[0] != 0x1f || hdr[1] != 0x8b || hdr[2] != 8 ||
-        !(hdr[3] & 4)) {
-      err = true; raw_eof = true; return nullptr;
+  bool salv() const { return sv != nullptr && sv->on; }
+
+  long fsize() {
+    if (file_size < 0) {
+      long cur = std::ftell(f);
+      std::fseek(f, 0, SEEK_END);
+      file_size = std::ftell(f);
+      std::fseek(f, cur, SEEK_SET);
     }
+    return file_size;
+  }
+
+  // salvage resync candidate check at `cand` (mirrors the Python
+  // rescan in io/bam.py _bgzf_salvage_chunks: magic + FEXTRA + a BC
+  // subfield whose BSIZE chains exactly to EOF or to another magic)
+  bool try_candidate(long cand, long sz) {
+    std::fseek(f, cand, SEEK_SET);
+    uint8_t hdr[12];
+    if (fread(hdr, 1, 12, f) != 12) return false;
+    if (!(hdr[0] == 0x1f && hdr[1] == 0x8b && hdr[2] == 8 &&
+          (hdr[3] & 4)))
+      return false;
     uint16_t xlen = (uint16_t)(hdr[10] | (hdr[11] << 8));
     std::vector<uint8_t> extra(xlen);
-    if (fread(extra.data(), 1, xlen, f) != xlen) {
-      err = true; raw_eof = true; return nullptr;
-    }
+    if (fread(extra.data(), 1, xlen, f) != xlen) return false;
     int64_t bsize = -1;
     for (size_t i = 0; i + 4 <= extra.size();) {
       uint16_t slen = (uint16_t)(extra[i + 2] | (extra[i + 3] << 8));
@@ -197,31 +260,134 @@ struct BgzfMT {
       }
       i += 4 + slen;
     }
-    if (bsize < (int64_t)(12 + xlen + 8)) {
-      err = true; raw_eof = true; return nullptr;
-    }
-    size_t payload = (size_t)(bsize - 12 - xlen - 8);
-    auto j = std::make_shared<Job>();
-    j->comp.resize(payload);
-    uint8_t tail[8];
-    if (fread(j->comp.data(), 1, payload, f) != payload ||
-        fread(tail, 1, 8, f) != 8) {
-      err = true; raw_eof = true; return nullptr;
-    }
-    std::memcpy(&j->crc, tail, 4);
-    std::memcpy(&j->isize, tail + 4, 4);
-    // BGZF caps the uncompressed block at 64KB; a larger ISIZE is file
-    // corruption — reject it here rather than letting inflate_job
-    // value-initialize an attacker-sized buffer per queued job
-    if (j->isize > (1u << 16)) {
-      err = true; raw_eof = true; return nullptr;
-    }
-    last_was_eof_marker = payload <= 4 && j->isize == 0;
-    return j;
+    if (bsize < (int64_t)(12 + xlen + 8)) return false;
+    if (cand + bsize > sz) return false;
+    if (cand + bsize == sz) return true;
+    std::fseek(f, cand + bsize, SEEK_SET);
+    uint8_t m[3];
+    if (fread(m, 1, 3, f) != 3) return false;
+    return m[0] == 0x1f && m[1] == 0x8b && m[2] == 8;
   }
 
-  // next decompressed block into *dst: size, 0 = clean EOF, -1 = error
-  int64_t next_block(std::vector<uint8_t>* dst) {
+  // scan forward from `from` for the next valid chained block header;
+  // repositions f and returns true, or false when none remains
+  bool rescan_from(long from) {
+    long sz = fsize();
+    uint8_t w[4096];
+    for (long o = from; o + 12 <= sz;) {
+      std::fseek(f, o, SEEK_SET);
+      size_t n = fread(w, 1, sizeof w, f);
+      if (n < 3) break;
+      for (size_t i = 0; i + 3 <= n; i++) {
+        if (w[i] == 0x1f && w[i + 1] == 0x8b && w[i + 2] == 0x08) {
+          long cand = o + (long)i;
+          if (try_candidate(cand, sz)) {
+            std::fseek(f, cand, SEEK_SET);
+            return true;
+          }
+        }
+      }
+      o += (long)(n >= 2 ? n - 2 : n);  // overlap: magic spans reads
+    }
+    return false;
+  }
+
+  // parse one raw BGZF member from f; null at EOF (err set on a
+  // malformed header/truncation — or, in salvage mode, the damage is
+  // classified, the stream resyncs on the next valid chained block
+  // header, and the next job carries gap_before)
+  std::shared_ptr<Job> read_raw() {
+    for (;;) {
+      long start = salv() ? std::ftell(f) : 0;
+      uint8_t hdr[12];
+      size_t n = fread(hdr, 1, 12, f);
+      if (n == 0) { raw_eof = true; return nullptr; }
+      bool hdr_ok = n == 12 && hdr[0] == 0x1f && hdr[1] == 0x8b &&
+                    hdr[2] == 8 && (hdr[3] & 4);
+      uint16_t xlen = 0;
+      std::vector<uint8_t> extra;
+      int64_t bsize = -1;
+      if (hdr_ok) {
+        xlen = (uint16_t)(hdr[10] | (hdr[11] << 8));
+        extra.resize(xlen);
+        if (fread(extra.data(), 1, xlen, f) != xlen) {
+          hdr_ok = false;
+        } else {
+          for (size_t i = 0; i + 4 <= extra.size();) {
+            uint16_t slen = (uint16_t)(extra[i + 2] | (extra[i + 3] << 8));
+            if (extra[i] == 'B' && extra[i + 1] == 'C' && slen == 2 &&
+                i + 6 <= extra.size()) {
+              bsize = (extra[i + 4] | (extra[i + 5] << 8)) + 1;
+              break;
+            }
+            i += 4 + slen;
+          }
+          if (bsize < (int64_t)(12 + xlen + 8)) hdr_ok = false;
+        }
+      }
+      if (hdr_ok && salv() && start + bsize > fsize()) hdr_ok = false;
+      if (!hdr_ok) {
+        if (!salv()) {
+          err = true; raw_eof = true;
+          err_reason = n < 12 ? "bgzf_torn_tail" : "bgzf_bad_block";
+          return nullptr;
+        }
+        // classification mirrors io/bam.py: fewer than a full fixed
+        // header left (or a block running past EOF) = torn tail,
+        // otherwise a damaged block header
+        sv->record(n < 12 || (bsize >= (int64_t)(12 + xlen + 8) &&
+                              start + bsize > fsize())
+                       ? "bgzf_torn_tail" : "bgzf_bad_block");
+        last_was_eof_marker = false;
+        if (!rescan_from(start + 1)) { raw_eof = true; return nullptr; }
+        gap_pending = true;
+        continue;
+      }
+      size_t payload = (size_t)(bsize - 12 - xlen - 8);
+      auto j = std::make_shared<Job>();
+      j->comp.resize(payload);
+      uint8_t tail[8];
+      if (fread(j->comp.data(), 1, payload, f) != payload ||
+          fread(tail, 1, 8, f) != 8) {
+        // non-salvage can reach this on streams where fsize() was not
+        // consulted; classification parity keeps it torn-tail
+        if (!salv()) {
+          err = true; raw_eof = true;
+          err_reason = "bgzf_torn_tail";
+          return nullptr;
+        }
+        sv->record("bgzf_torn_tail");
+        last_was_eof_marker = false;
+        raw_eof = true;
+        return nullptr;
+      }
+      std::memcpy(&j->crc, tail, 4);
+      std::memcpy(&j->isize, tail + 4, 4);
+      last_was_eof_marker = payload <= 4 && j->isize == 0;
+      // BGZF caps the uncompressed block at 64KB; a larger ISIZE is
+      // file corruption — reject it here rather than letting
+      // inflate_job value-initialize an attacker-sized buffer
+      if (j->isize > (1u << 16)) {
+        if (!salv()) {
+          err = true; raw_eof = true;
+          err_reason = "bgzf_bad_deflate";
+          return nullptr;
+        }
+        sv->record("bgzf_bad_deflate");
+        gap_pending = true;
+        continue;
+      }
+      j->gap_before = gap_pending;
+      gap_pending = false;
+      return j;
+    }
+  }
+
+  // next decompressed block into *dst: size, 0 = clean EOF, -1 = error.
+  // *gap_before (may be null) reports salvage-dropped bytes preceding
+  // this block's data.
+  int64_t next_block(std::vector<uint8_t>* dst, bool* gap_before) {
+    if (gap_before) *gap_before = false;
     for (;;) {
       while (!raw_eof && order.size() < depth) {
         auto j = read_raw();
@@ -242,8 +408,21 @@ struct BgzfMT {
         // a clean BGZF stream ends with the empty EOF-marker block
         // (write_bgzf/htslib emit it); missing it means the file was
         // truncated at a block boundary — surface that as an error
-        // instead of silently processing the surviving prefix
-        if (!err && !last_was_eof_marker) err = true;
+        // (or, in salvage mode, one classified torn-tail event)
+        if (!err && !last_was_eof_marker) {
+          if (salv()) {
+            // budget-exempt reason (corruption.NON_BUDGET_REASONS):
+            // a healthy file that merely lost its marker emits every
+            // hole intact
+            if (!end_counted) {
+              end_counted = true;
+              sv->record("bgzf_missing_eof");
+            }
+          } else {
+            err = true;
+            err_reason = "bgzf_missing_eof";
+          }
+        }
         return err ? -1 : 0;
       }
       auto j = order.front();
@@ -252,8 +431,22 @@ struct BgzfMT {
         std::unique_lock<std::mutex> lk(mu);
         cv_done.wait(lk, [&] { return j->done; });
       }
-      if (j->bad) { err = true; return -1; }
-      if (j->out.empty()) continue;  // empty block (e.g. EOF marker)
+      if (j->bad) {
+        if (salv()) {
+          sv->record("bgzf_bad_deflate");
+          pending_gap_out = true;
+          continue;
+        }
+        err = true;
+        err_reason = "bgzf_bad_deflate";
+        return -1;
+      }
+      if (j->out.empty()) {           // empty block (e.g. EOF marker)
+        pending_gap_out |= j->gap_before;
+        continue;
+      }
+      if (gap_before) *gap_before = j->gap_before || pending_gap_out;
+      pending_gap_out = false;
       dst->swap(j->out);
       return (int64_t)dst->size();
     }
@@ -269,6 +462,20 @@ struct GzStream {
   int64_t begin = 0, end = 0;
   bool eof = false;
   bool err = false;  // corrupt/truncated gzip stream (gzread < 0)
+  const char* err_reason = nullptr;  // taxonomy code for err
+  Salvage* sv = nullptr;
+  // salvage: the CURRENT buffer is preceded by dropped (damaged)
+  // bytes; consumers must not parse across the boundary.  gap_events
+  // counts deliveries for readers that only need "did one happen".
+  bool gap_before = false;
+  int64_t gap_events = 0;
+
+  bool salv() const { return sv != nullptr && sv->on; }
+
+  void set_salvage(Salvage* s) {
+    sv = s;
+    if (bgzf) bgzf->sv = s;
+  }
 
   bool open(const char* path) {
     if (std::strcmp(path, "-") != 0) {
@@ -303,22 +510,40 @@ struct GzStream {
   bool fill() {
     if (eof) return false;
     if (bgzf) {
-      int64_t n = bgzf->next_block(&buf);
+      bool gap = false;
+      int64_t n = bgzf->next_block(&buf, &gap);
       begin = 0;
       end = n > 0 ? n : 0;
-      if (n < 0) { eof = true; err = true; return false; }
+      if (gap) { gap_before = true; gap_events++; }
+      if (n < 0) {
+        eof = true; err = true;
+        err_reason = bgzf->err_reason;
+        return false;
+      }
       if (n == 0) { eof = true; return false; }
       return true;
     }
     int n = gzread(gz, buf.data(), (unsigned)buf.size());
     begin = 0;
     end = n > 0 ? n : 0;
-    if (n < 0) { eof = true; err = true; return false; }
+    if (n < 0) {
+      eof = true; err = true;
+      err_reason = "gzip_truncated";
+      // a broken deflate stream has no block structure to resync on:
+      // salvage classifies it once and ends the stream (the records
+      // already delivered are the salvage)
+      if (salv()) sv->record("gzip_truncated");
+      return false;
+    }
     if (n == 0) {
       // distinguish clean EOF from a truncated deflate stream
       int errnum = Z_OK;
       gzerror(gz, &errnum);
-      if (errnum != Z_OK && errnum != Z_STREAM_END) err = true;
+      if (errnum != Z_OK && errnum != Z_STREAM_END) {
+        err = true;
+        err_reason = "gzip_truncated";
+        if (salv()) sv->record("gzip_truncated");
+      }
       eof = true;
       return false;
     }
@@ -381,23 +606,54 @@ struct Record {
 struct FastxReader {
   GzStream s;
   int last_char = 0;  // 0 = need to scan for marker; else the marker byte
+  Salvage* sv = nullptr;
+
+  bool salv() const { return sv != nullptr && sv->on; }
+
+  // salvage resync: skip to the next line STARTING with '>'/'@' (the
+  // line-anchored rule io/fastx.py uses — a '@' inside a quality line
+  // must not anchor).  Called at a line boundary.
+  void line_resync() {
+    for (;;) {
+      int c = s.getc();
+      if (c == -1) { last_char = 0; return; }
+      if (c == '>' || c == '@') { last_char = c; return; }
+      // a blank line: the consumed '\n' already leaves us at the next
+      // line start — consuming another line here would swallow a
+      // record header after a blank line (io/fastx.py keeps it)
+      if (c == '\n') continue;
+      std::string skip;
+      if (s.getuntil(1, &skip) == -1) { last_char = 0; return; }
+    }
+  }
 
   // returns: 1 record, 0 EOF, -2 malformed (qual length mismatch),
-  // -3 corrupt gzip stream
+  // -3 corrupt gzip stream.  Salvage mode never returns -2/-3: the
+  // corruption is classified, the parser resyncs, and the next good
+  // record (or EOF) is returned.
   int next(Record* r) {
+    for (;;) {
+      int rc = next_impl(r);
+      if (rc != -9) return rc;   // -9 = salvage drop, retry
+    }
+  }
+
+  int next_impl(Record* r) {
     r->clear();
+    int64_t gap0 = s.gap_events;
     int c = last_char;
     if (c == 0) {
       while ((c = s.getc()) != -1 && c != '>' && c != '@') {}
-      if (c == -1) return s.err ? -3 : 0;
+      if (c == -1) return (s.err && !salv()) ? -3 : 0;
     }
     last_char = 0;
     int marker = c;
     // name = first whitespace token; comment = rest of line
     c = s.getuntil(0, &r->name);
     if (c == -1) {
-      if (s.err) return -3;
-      return r->name.empty() ? 0 : 1;
+      if (s.err && !salv()) return -3;
+      if (r->name.empty()) return 0;
+      return finish_record(r, marker, gap0, false);
     }
     if (c != '\n') {
       c = s.getuntil(1, &r->comment);
@@ -423,11 +679,22 @@ struct FastxReader {
       r->seq.append(tmp);
       if (d == -1) { c = -1; break; }
     }
-    if (c == '>' || c == '@') { last_char = c; return 1; }
-    if (s.err) return -3;    // truncated gzip mid-sequence
-    if (c != '+') return 1;  // EOF after sequence
+    if (c == '>' || c == '@') {
+      last_char = c;
+      return finish_record(r, marker, gap0, false);
+    }
+    if (s.err && !salv()) return -3;    // truncated gzip mid-sequence
+    if (c != '+') return finish_record(r, marker, gap0, false);
     // '+' line: skip to end of line, then read quality until length match
-    { std::string skip; if (s.getuntil(1, &skip) == -1) return -2; }
+    {
+      std::string skip;
+      if (s.getuntil(1, &skip) == -1) {
+        if (!salv()) return -2;
+        if (r->seq.empty()) return finish_record(r, marker, gap0, true);
+        sv->record("fastx_truncated");
+        return 0;   // EOF: nothing to resync onto
+      }
+    }
     while (r->qual.size() < r->seq.size()) {
       std::string line;
       int d = s.getuntil(1, &line);
@@ -435,22 +702,84 @@ struct FastxReader {
       r->qual.append(line);
       if (d == -1) break;
     }
-    if (s.err) return -3;
-    if (r->qual.size() != r->seq.size()) return -2;
-    // kseq parity: the quality section is *parsed* after any record, but
-    // reported only for '@' records (io/fastx.py does the same).
-    r->has_qual = (marker == '@');
-    if (!r->has_qual) r->qual.clear();
+    if (s.err && !salv()) return -3;
+    if (r->qual.size() != r->seq.size()) {
+      if (!salv()) return -2;
+      // shorter = the stream ended under the record; longer = a
+      // damaged quality section (mirrors io/fastx.py)
+      sv->record(r->qual.size() < r->seq.size() ? "fastx_truncated"
+                                                : "fastx_qual_mismatch");
+      line_resync();
+      return -9;
+    }
+    return finish_record(r, marker, gap0, true);
+  }
+
+  int finish_record(Record* r, int marker, int64_t gap0, bool has_q) {
+    if (salv() && s.gap_events != gap0) {
+      // the record's bytes span a BGZF salvage gap: a chimera of two
+      // damaged regions — drop it and re-anchor (the gap itself was
+      // already classified by the block layer)
+      line_resync();
+      return -9;
+    }
+    if (has_q) {
+      // kseq parity: the quality section is *parsed* after any record,
+      // but reported only for '@' records (io/fastx.py does the same).
+      r->has_qual = (marker == '@');
+      if (!r->has_qual) r->qual.clear();
+    }
     return 1;
   }
 };
 
 // ---- BAM reader (bamlite.c:78-165 semantics) ----------------------------
 
+// plausible-record predicate for the salvage resync scan — MUST match
+// io/corruption.py record_plausible (the shared contract the
+// differential fuzz tests pin)
+inline bool record_plausible(const uint8_t* b, size_t avail,
+                             int64_t max_rec) {
+  if (avail < 36) return false;
+  int32_t block_size, refid, pos, l_seq;
+  uint16_t n_cigar;
+  std::memcpy(&block_size, b, 4);
+  if (block_size < kMinRecordBlock || block_size > max_rec) return false;
+  std::memcpy(&refid, b + 4, 4);
+  std::memcpy(&pos, b + 8, 4);
+  if (!(refid == -1 || (refid >= 0 && refid < 100000)) || pos < -1)
+    return false;
+  uint8_t lrn = b[12];
+  if (lrn < 2) return false;
+  std::memcpy(&n_cigar, b + 16, 2);
+  std::memcpy(&l_seq, b + 20, 4);
+  if (l_seq < 0) return false;
+  // 64-bit arithmetic: (l_seq + 1) on an attacker-controlled INT32_MAX
+  // would be signed-overflow UB in 32 bits
+  if (32 + (int64_t)lrn + 4 * (int64_t)n_cigar +
+          ((int64_t)l_seq + 1) / 2 + (int64_t)l_seq > (int64_t)block_size)
+    return false;
+  if (avail < (size_t)36 + lrn) return false;
+  if (b[36 + lrn - 1] != 0) return false;
+  for (size_t i = 0; i + 1 < lrn; i++)
+    if (b[36 + i] < 0x21 || b[36 + i] > 0x7E) return false;
+  return true;
+}
+
 struct BamReader {
   GzStream s;
   bool header_done = false;
   std::vector<uint8_t> block;
+  Salvage* sv = nullptr;
+
+  // salvage feed: records are parsed out of `pend` so the scan can
+  // look arbitrarily far ahead and a BGZF gap can be surfaced exactly
+  // between the bytes on its two sides (io/bam.py _SalvageFeed mirror)
+  std::string pend;
+  size_t pos = 0;
+  bool resync = false;
+
+  bool salv() const { return sv != nullptr && sv->on; }
 
   // returns 0 ok, -3 bad header
   int read_header() {
@@ -458,14 +787,20 @@ struct BamReader {
     if (s.read(magic, 4) != 4 || std::memcmp(magic, "BAM\1", 4) != 0)
       return -3;
     int32_t l_text;
-    if (s.read((uint8_t*)&l_text, 4) != 4 || l_text < 0) return -3;
+    if (s.read((uint8_t*)&l_text, 4) != 4 || l_text < 0 ||
+        l_text > max_rec())
+      return -3;
     std::vector<uint8_t> skip((size_t)l_text);
     if (s.read(skip.data(), l_text) != l_text) return -3;
     int32_t n_ref;
-    if (s.read((uint8_t*)&n_ref, 4) != 4 || n_ref < 0) return -3;
+    if (s.read((uint8_t*)&n_ref, 4) != 4 || n_ref < 0 ||
+        n_ref > 1 << 24)
+      return -3;
     for (int32_t i = 0; i < n_ref; i++) {
       int32_t l_name;
-      if (s.read((uint8_t*)&l_name, 4) != 4 || l_name < 0) return -3;
+      if (s.read((uint8_t*)&l_name, 4) != 4 || l_name < 1 ||
+          l_name > 4096)
+        return -3;
       skip.resize((size_t)l_name + 4);
       if (s.read(skip.data(), l_name + 4) != l_name + 4) return -3;
     }
@@ -473,34 +808,26 @@ struct BamReader {
     return 0;
   }
 
-  // returns: 1 record, 0 clean EOF, -3 truncated/bad stream
-  int next(Record* r) {
-    if (!header_done) {
-      int rc = read_header();
-      if (rc != 0) return rc;
-    }
-    r->clear();
-    int32_t block_size;
-    int64_t got = s.read((uint8_t*)&block_size, 4);
-    if (got == 0) return s.err ? -3 : 0;  // clean EOF (bamlite.c:141)
-    if (got != 4 || block_size < 32) return -3;
-    block.resize((size_t)block_size);
-    if (s.read(block.data(), block_size) != block_size) return -3;
-    const uint8_t* p = block.data();
+  // decode one alignment block at p (block_size bytes after the length
+  // int) into r; false on inconsistent fields.  Shared by the fail-
+  // fast and salvage paths so decode semantics can never diverge.
+  bool decode_block(const uint8_t* p, int32_t block_size, Record* r) {
     uint8_t l_read_name = p[8];
     uint16_t n_cigar;
     int32_t l_seq;
     std::memcpy(&n_cigar, p + 12, 2);
     std::memcpy(&l_seq, p + 16, 4);
-    if (l_seq < 0) return -3;  // corrupt record; resize would throw
+    if (l_read_name < 1) return false;  // io/bam.py decode_record parity
+    if (l_seq < 0) return false;  // corrupt record; resize would throw
     int64_t off = 32;
-    if (off + l_read_name > block_size) return -3;
+    if (off + l_read_name > block_size) return false;
     r->name.assign((const char*)p + off,
                    l_read_name > 0 ? (size_t)(l_read_name - 1) : 0);
     off += l_read_name;
     off += 4 * (int64_t)n_cigar;
-    int64_t nseq_bytes = (l_seq + 1) / 2;
-    if (off + nseq_bytes + l_seq > block_size) return -3;
+    // 64-bit: (l_seq + 1) at INT32_MAX would be signed-overflow UB
+    int64_t nseq_bytes = ((int64_t)l_seq + 1) / 2;
+    if (off + nseq_bytes + l_seq > block_size) return false;
     r->seq.resize((size_t)l_seq);
     for (int64_t i = 0; i < nseq_bytes; i++) {
       const uint8_t* two = kT.nib[p[off + i]];
@@ -514,7 +841,167 @@ struct BamReader {
       r->qual[(size_t)i] = (char)(q > 126 ? 126 : q);
     }
     r->has_qual = true;
+    return true;
+  }
+
+  const char* err_reason = nullptr;  // taxonomy code for a -3 here
+
+  // the --max-record-bytes bound applies salvage ON OR OFF: the
+  // Salvage struct is wired at open either way (sv->on gates only the
+  // resync behavior)
+  int64_t max_rec() const {
+    return sv ? sv->max_record_bytes : kDefaultMaxRecordBytes;
+  }
+
+  // returns: 1 record, 0 clean EOF, -3 truncated/bad stream
+  int next(Record* r) {
+    if (salv()) return next_salvage(r);
+    if (!header_done) {
+      int rc = read_header();
+      if (rc != 0) { err_reason = "bam_bad_header"; return rc; }
+    }
+    r->clear();
+    int32_t block_size;
+    int64_t got = s.read((uint8_t*)&block_size, 4);
+    if (got == 0) return s.err ? -3 : 0;  // clean EOF (bamlite.c:141)
+    if (got != 4 || block_size < 32 || block_size > max_rec()) {
+      // the allocation bound: a corrupt int32 must be rejected BEFORE
+      // block.resize() commits to it
+      err_reason = (got == 4 && block_size > max_rec())
+                       ? "bam_record_oversize" : "bam_bad_record";
+      return -3;
+    }
+    block.resize((size_t)block_size);
+    if (s.read(block.data(), block_size) != block_size) {
+      err_reason = "bam_bad_record";
+      return -3;
+    }
+    if (!decode_block(block.data(), block_size, r)) {
+      err_reason = "bam_bad_record";
+      return -3;
+    }
     return 1;
+  }
+
+  // ---- salvage path (io/bam.py _read_bam_salvage mirror) ----------------
+
+  // 0 ok, 1 gap (call take_gap), 2 eof
+  int ensure(size_t n) {
+    while (pend.size() - pos < n) {
+      if (s.begin >= s.end) {
+        if (!s.fill()) {
+          if (s.gap_before) { s.gap_before = false; return 1; }
+          return 2;
+        }
+        if (s.gap_before) { s.gap_before = false; return 1; }
+      }
+      pend.append((const char*)s.buf.data() + s.begin,
+                  (size_t)(s.end - s.begin));
+      s.begin = s.end;
+    }
+    return 0;
+  }
+
+  void take_gap() { pend.resize(pos); }
+
+  void compact() {
+    if (pos > (size_t)(1 << 16)) {
+      pend.erase(0, pos);
+      pos = 0;
+    }
+  }
+
+  // 0 found, 2 eof (tail consumed)
+  int scan_for_record() {
+    int64_t max_rec = sv->max_record_bytes;
+    for (;;) {
+      int st = ensure((size_t)kScanLookahead);
+      if (st == 1) { take_gap(); continue; }
+      size_t avail = pend.size() - pos;
+      if (st == 2 && avail < 36) { pos = pend.size(); return 2; }
+      if (record_plausible((const uint8_t*)pend.data() + pos, avail,
+                           max_rec))
+        return 0;
+      pos++;
+      compact();
+    }
+  }
+
+  // tolerant header parse over the feed; false = damaged (fall back
+  // to the record scan).  Mirrors io/bam.py _salvage_header.
+  bool salvage_header() {
+    if (ensure(12) != 0 ||
+        std::memcmp(pend.data() + pos, "BAM\1", 4) != 0)
+      return false;
+    int32_t l_text, n_ref;
+    std::memcpy(&l_text, pend.data() + pos + 4, 4);
+    if (l_text < 0 || l_text > kDefaultMaxRecordBytes) return false;
+    if (ensure(12 + (size_t)l_text) != 0) return false;
+    std::memcpy(&n_ref, pend.data() + pos + 8 + l_text, 4);
+    if (n_ref < 0 || n_ref > 1 << 24) return false;
+    pos += 12 + (size_t)l_text;
+    for (int32_t i = 0; i < n_ref; i++) {
+      if (ensure(4) != 0) return false;
+      int32_t l_name;
+      std::memcpy(&l_name, pend.data() + pos, 4);
+      if (l_name < 1 || l_name > 4096) return false;
+      if (ensure(8 + (size_t)l_name) != 0) return false;
+      pos += 8 + (size_t)l_name;
+    }
+    return true;
+  }
+
+  int next_salvage(Record* r) {
+    int64_t max_rec = sv->max_record_bytes;
+    if (!header_done) {
+      if (!salvage_header()) {
+        sv->record("bam_bad_header");
+        resync = true;
+      }
+      header_done = true;
+    }
+    r->clear();
+    for (;;) {
+      compact();
+      if (resync) {
+        if (scan_for_record() == 2) return 0;
+        resync = false;
+      }
+      int st = ensure(4);
+      if (st == 1) { take_gap(); resync = true; continue; }
+      if (st == 2) {
+        if (pend.size() - pos > 0) {
+          sv->record("bam_bad_record");
+          pos = pend.size();
+        }
+        return 0;
+      }
+      int32_t block_size;
+      std::memcpy(&block_size, pend.data() + pos, 4);
+      if (block_size < kMinRecordBlock || block_size > max_rec) {
+        sv->record(block_size > max_rec ? "bam_record_oversize"
+                                        : "bam_bad_record");
+        pos++;
+        resync = true;
+        continue;
+      }
+      st = ensure(4 + (size_t)block_size);
+      if (st == 1) { take_gap(); resync = true; continue; }
+      if (st == 2) {
+        sv->record("bam_bad_record");
+        pos = pend.size();
+        return 0;
+      }
+      if (!decode_block((const uint8_t*)pend.data() + pos + 4,
+                        block_size, r)) {
+        sv->record("bam_bad_record");
+        pos++;
+        resync = true;
+        continue;
+      }
+      pos += 4 + (size_t)block_size;
+      return 1;
+    }
   }
 };
 
@@ -525,6 +1012,27 @@ struct Reader {
   FastxReader fx;
   BamReader bam;
   std::string error;
+  std::string reason;   // stable taxonomy code for `error` (corruption.py)
+  Salvage salvage;      // salvage-mode switch + per-reason accounting
+
+  // wire the shared Salvage into every layer (called at open; the
+  // --max-record-bytes bound applies even with salvage OFF — sv->on
+  // gates only the resync behavior)
+  void wire_salvage() {
+    fx.sv = &salvage;
+    bam.sv = &salvage;
+    (is_bam ? bam.s : fx.s).set_salvage(&salvage);
+  }
+
+  void set_max_record_bytes(int64_t max_record_bytes) {
+    if (max_record_bytes > 0)
+      salvage.max_record_bytes = max_record_bytes;
+  }
+
+  void enable_salvage(int64_t max_record_bytes) {
+    salvage.on = true;
+    set_max_record_bytes(max_record_bytes);
+  }
 
   // filters (main.c:659-672); 0/absent = keep everything
   int32_t min_passes = 0;
@@ -562,6 +1070,16 @@ struct Reader {
     return is_bam ? bam.next(r) : fx.next(r);
   }
 
+  // taxonomy code for a -3 stream error in fail-fast mode: the
+  // container layer's classification wins (it is causal), then the
+  // record layer's, then the format's generic truncation code
+  const char* stream_reason() {
+    GzStream& s = is_bam ? bam.s : fx.s;
+    if (s.err_reason) return s.err_reason;
+    if (is_bam && bam.err_reason) return bam.err_reason;
+    return is_bam ? "bam_bad_record" : "fastx_truncated";
+  }
+
   bool keep() const {
     if (min_passes > 0 && (int32_t)lens.size() < min_passes) return false;
     int64_t total = (int64_t)seqs.size();
@@ -578,6 +1096,7 @@ struct Reader {
       if (have_carry) {
         if (!split3(carry.name, &movie, &hole)) {
           error = "invalid zmw name :" + carry.name;
+          reason = "zmw_bad_name";
           return -2;
         }
         seqs.append(carry.seq);
@@ -588,11 +1107,27 @@ struct Reader {
         Record r;
         int rc = next_record(&r);
         if (rc == 0) { stream_done = true; break; }
-        if (rc == -2) { error = "malformed FASTQ record: " + r.name; return -3; }
-        if (rc < 0) { error = "truncated or corrupt input stream"; return -3; }
+        if (rc == -2) {
+          error = "malformed FASTQ record: " + r.name;
+          reason = "fastx_qual_mismatch";
+          return -3;
+        }
+        if (rc < 0) {
+          error = "truncated or corrupt input stream";
+          if (reason.empty()) reason = stream_reason();
+          return -3;
+        }
         std::string m, h;
         if (!split3(r.name, &m, &h)) {
+          if (salvage.on) {
+            // salvage: the poisoned record is dropped and booked;
+            // grouping re-anchors on the next record (io/zmw.py
+            // group_zmws applies the same rule)
+            salvage.record("zmw_bad_name");
+            continue;
+          }
           error = "invalid zmw name :" + r.name;
+          reason = "zmw_bad_name";
           return -2;
         }
         if (lens.empty()) {
@@ -751,6 +1286,7 @@ void* ccsx_open(const char* path, int is_bam) {
   r->is_bam = is_bam != 0;
   GzStream& s = r->is_bam ? r->bam.s : r->fx.s;
   if (!s.open(path)) { delete r; return nullptr; }
+  r->wire_salvage();
   return r;
 }
 
@@ -760,6 +1296,17 @@ void ccsx_set_filter(void* h, int32_t min_passes, int64_t min_total,
   r->min_passes = min_passes;
   r->min_total = min_total;
   r->max_total = max_total;
+}
+
+// Salvage mode (--salvage): classified corruption is booked + resynced
+// past instead of erroring the stream.  Must be called before the
+// first next_* call.  max_record_bytes <= 0 keeps the default bound;
+// with on == 0 only the bound is applied (fail-fast keeps its
+// behavior, just with the caller's allocation limit).
+void ccsx_set_salvage(void* h, int on, int64_t max_record_bytes) {
+  Reader* r = (Reader*)h;
+  r->set_max_record_bytes(max_record_bytes);
+  if (on) r->enable_salvage(max_record_bytes);
 }
 
 // Fetch the next (filtered) hole. Returns n_passes>=0, -1 EOF, -2 invalid
@@ -797,15 +1344,38 @@ int ccsx_next_record(void* h, const char** name, const char** comment,
     *qual_len = r->carry.has_qual ? (int64_t)r->carry.qual.size() : -1;
   } else if (rc == -2) {
     r->error = "malformed FASTQ record: " + r->carry.name;
+    r->reason = "fastx_qual_mismatch";
     rc = -3;
   } else if (rc < 0) {
     if (r->error.empty()) r->error = "truncated or invalid stream";
+    if (r->reason.empty()) r->reason = r->stream_reason();
     rc = -3;
   }
   return rc;
 }
 
 const char* ccsx_error(void* h) { return ((Reader*)h)->error.c_str(); }
+
+// Stable taxonomy code (io/corruption.py REASONS) for the last error
+// reported by this handle; empty when none.
+const char* ccsx_error_reason(void* h) {
+  return ((Reader*)h)->reason.c_str();
+}
+
+// Salvage accounting: total classified corruption events (live-safe —
+// atomic), and the per-reason summary "reason:count,..." (call only
+// after EOF; the buffer is owned by the handle).
+int64_t ccsx_corrupt_events(void* h) {
+  return ((Reader*)h)->salvage.events.load(std::memory_order_relaxed);
+}
+
+int64_t ccsx_corrupt_exempt(void* h) {
+  return ((Reader*)h)->salvage.exempt.load(std::memory_order_relaxed);
+}
+
+const char* ccsx_corrupt_summary(void* h) {
+  return ((Reader*)h)->salvage.build_summary();
+}
 
 // Filter accounting (reason-bucketed counts of holes the in-library
 // filters dropped).  Valid at any point; complete once next_zmw
@@ -834,6 +1404,29 @@ void* ccsx_prefetch_open(const char* path, int is_bam, int32_t min_passes,
   p->reader.is_bam = is_bam != 0;
   GzStream& s = p->reader.is_bam ? p->reader.bam.s : p->reader.fx.s;
   if (!s.open(path)) { delete p; return nullptr; }
+  p->reader.wire_salvage();
+  p->reader.min_passes = min_passes;
+  p->reader.min_total = min_total;
+  p->reader.max_total = max_total;
+  if (queue_cap > 0) p->cap = (size_t)queue_cap;
+  p->th = std::thread([p] { p->run(); });
+  return p;
+}
+
+// Salvage-capable prefetch open: salvage must be fixed before the
+// producer thread starts, hence a distinct entry point rather than a
+// set_* call (the plain open keeps its historical signature).
+void* ccsx_prefetch_open_s(const char* path, int is_bam,
+                           int32_t min_passes, int64_t min_total,
+                           int64_t max_total, int32_t queue_cap,
+                           int salvage, int64_t max_record_bytes) {
+  Prefetcher* p = new Prefetcher();
+  p->reader.is_bam = is_bam != 0;
+  GzStream& s = p->reader.is_bam ? p->reader.bam.s : p->reader.fx.s;
+  if (!s.open(path)) { delete p; return nullptr; }
+  p->reader.wire_salvage();
+  p->reader.set_max_record_bytes(max_record_bytes);
+  if (salvage) p->reader.enable_salvage(max_record_bytes);
   p->reader.min_passes = min_passes;
   p->reader.min_total = min_total;
   p->reader.max_total = max_total;
@@ -860,6 +1453,30 @@ int ccsx_prefetch_next(void* h, const char** movie, const char** hole,
 
 const char* ccsx_prefetch_error(void* h) {
   return ((Prefetcher*)h)->reader.error.c_str();
+}
+
+const char* ccsx_prefetch_error_reason(void* h) {
+  return ((Prefetcher*)h)->reader.reason.c_str();
+}
+
+// Live classified-corruption event count (atomic: the producer thread
+// books while the consumer polls).
+int64_t ccsx_prefetch_corrupt_events(void* h) {
+  return ((Prefetcher*)h)
+      ->reader.salvage.events.load(std::memory_order_relaxed);
+}
+
+int64_t ccsx_prefetch_corrupt_exempt(void* h) {
+  return ((Prefetcher*)h)
+      ->reader.salvage.exempt.load(std::memory_order_relaxed);
+}
+
+// Per-reason summary; call after EOF (pop() returned rc_final) — the
+// queue-mutex handoff orders the producer's final writes before this.
+const char* ccsx_prefetch_corrupt_summary(void* h) {
+  Prefetcher* p = (Prefetcher*)h;
+  std::lock_guard<std::mutex> lk(p->mu);
+  return p->reader.salvage.build_summary();
 }
 
 // Same accounting for the prefetching streamer.  The counters are

@@ -254,9 +254,11 @@ def run_pipeline_sharded(in_path: str, out_path: str, cfg: CcsConfig,
                 m.ingest_bytes += nbytes
 
             stream = zmw_mod.stream_zmws(
-                bamindex.read_hole_range(in_path, idx, range_lo,
-                                         range_hi, counter=_count), cfg,
-                metrics=metrics)
+                bamindex.read_hole_range(
+                    in_path, idx, range_lo, range_hi, counter=_count,
+                    max_record_bytes=getattr(cfg, "max_record_bytes",
+                                             0)),
+                cfg, metrics=metrics)
         else:
             stream = open_zmw_stream(in_path, cfg, metrics=metrics)
             if in_path != "-" and os.path.exists(in_path):

@@ -2096,6 +2096,8 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
     from ccsx_tpu.io import zmw as zmw_mod
     from ccsx_tpu.pipeline.prep_pool import (PrepPool,
                                              resolve_prep_threads)
+    from ccsx_tpu.pipeline.run import guarded_stream
+    from ccsx_tpu.utils.drain import DrainGuard
 
     # non-positive --inflight keeps its historical meaning of "use the
     # default" (which is now the adaptive window), rather than pinning
@@ -2183,6 +2185,9 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
             # journal + durable records behind, exactly like a real
             # OOM-kill between holes
             faultinject.fire("rank_death")
+            # sigterm delivers a REAL signal at the same point — the
+            # graceful-drain path, made deterministic
+            faultinject.fire("sigterm")
             metrics.tick()
             next_emit += 1
             if pool is not None:
@@ -2195,6 +2200,14 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
             warm_hole(h)
             active.append(h)
 
+    # graceful drain (utils/drain.py) + the input_corrupt/salvage
+    # ingest rungs: every ingestion path — inline admission AND the
+    # prep pool's background workers — consumes the wrapped stream.
+    # Installed HERE, immediately before the try whose finally restores
+    # the handlers: installing any earlier would leak them if an
+    # executor/resilience constructor above raised
+    guard = DrainGuard.install()
+    stream = guarded_stream(stream, cfg, metrics, guard)
     # the flight recorder (utils/trace.py): span JSONL under --trace,
     # and the stall watchdog + group attribution regardless — the
     # watchdog must be live on every batched run, or the next hang is
@@ -2356,8 +2369,10 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
             # retired yet (a holes<=inflight run drains at the very end)
             metrics.heartbeat()
         # fraction-form --max-failed-holes settles at end of run, when
-        # the processed-hole denominator is final (metrics.py)
-        check_failure_budget(metrics, cfg, final=True)
+        # the processed-hole denominator is final (metrics.py) — but
+        # not on a drain, whose denominator is a partial run's
+        if not guard.requested:
+            check_failure_budget(metrics, cfg, final=True)
     except FailureBudgetExceeded as e:
         from ccsx_tpu import exitcodes
 
@@ -2371,6 +2386,7 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
         print(f"Error: write failed: {e}", file=sys.stderr)
         rc = 1
     finally:
+        guard.restore()
         try:
             writer.close()
         except OSError as e:
@@ -2399,6 +2415,12 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
         if telem is not None:
             telem.close()
         metrics.report()
+    if rc == 0 and guard.requested:
+        from ccsx_tpu import exitcodes
+
+        print("[ccsx-tpu] drained cleanly; resume with the same "
+              "command to continue", file=sys.stderr)
+        rc = exitcodes.RC_INTERRUPTED
     return rc
 
 

@@ -20,6 +20,7 @@ from ccsx_tpu.consensus.align_host import HostAligner
 from ccsx_tpu.consensus.hole import ccs_hole
 from ccsx_tpu.io import bam as bam_mod
 from ccsx_tpu.io import fastx, zmw
+from ccsx_tpu.io.corruption import CorruptionError, SalvageSink
 from ccsx_tpu.utils import faultinject
 from ccsx_tpu.utils import trace
 from ccsx_tpu.utils.device import resolve_device
@@ -38,17 +39,96 @@ def open_zmw_stream(path: str, cfg: CcsConfig, metrics=None):
     caller's error handling.  ``metrics`` (optional) receives the
     filtered-hole accounting from either path: per-hole live on the
     Python path, reason-bucketed at EOF from the native reader.
+
+    ``cfg.salvage`` selects salvage-mode ingest on whichever stack
+    serves: classified corruption is booked into Metrics
+    (holes_corrupt + corrupt_reasons + the degraded mark) and resynced
+    past instead of killing the stream (io/corruption.py).
     """
     from ccsx_tpu import native
 
+    salvage = bool(getattr(cfg, "salvage", False))
     if path != "-" and native.available():
-        from ccsx_tpu.native.io import stream_zmws_prefetch
+        from ccsx_tpu.native.io import (salvage_supported,
+                                        stream_zmws_prefetch)
 
-        return stream_zmws_prefetch(path, cfg, metrics=metrics)
-    f = sys.stdin.buffer if path == "-" else open(path, "rb")
-    records = (bam_mod.read_bam_records(f) if cfg.is_bam
-               else fastx.read_fastx(f))
-    return zmw.stream_zmws(records, cfg, metrics=metrics)
+        if not salvage or salvage_supported():
+            return stream_zmws_prefetch(path, cfg, metrics=metrics)
+        # stale prebuilt .so without the salvage entry points: fall
+        # through to the pure-Python salvage readers
+    sink = SalvageSink(metrics, getattr(cfg, "max_record_bytes", 0)) \
+        if salvage else None
+    if cfg.is_bam:
+        if path == "-":
+            records = bam_mod.read_bam_records(
+                sys.stdin.buffer, salvage=sink,
+                max_record_bytes=getattr(cfg, "max_record_bytes", 0))
+        else:
+            open(path, "rb").close()   # eager-open contract (OSError now)
+            records = bam_mod.read_bam_records(
+                path, salvage=sink,
+                max_record_bytes=getattr(cfg, "max_record_bytes", 0))
+    else:
+        f = sys.stdin.buffer if path == "-" else open(path, "rb")
+        records = fastx.read_fastx(f, salvage=sink)
+    return zmw.stream_zmws(records, cfg, metrics=metrics, salvage=sink)
+
+
+def guarded_stream(stream, cfg: CcsConfig, metrics, guard=None):
+    """The drivers' shared ingest guard, wrapped around any open ZMW
+    stream (single-process, batched, and sharded drivers all route
+    ingestion through here — prep-pool workers included, since the
+    pool consumes the wrapped iterator):
+
+    * graceful drain: once ``guard.requested`` (SIGTERM/SIGINT,
+      utils/drain.py) the stream reports exhausted — admission stops,
+      in-flight work finishes, and the driver exits RC_INTERRUPTED;
+    * the ``input_corrupt`` fault point (utils/faultinject.py): with
+      --salvage the injected corruption drops that one hole and the
+      stream CONTINUES; without it, the clean rc-1 path;
+    * the salvage rung for classified corruption raised by the stream
+      itself (e.g. the range-sharded reader, which classifies but has
+      no resync): with --salvage the event is booked and the stream
+      ENDS there — a generator that raised is closed, so the remaining
+      range is lost either way; booking + rc 0 degraded beats killing
+      the whole run.  (The salvage-mode readers resync internally and
+      never raise here.)
+    * an absolute --max-failed-holes budget is re-checked per admitted
+      hole, so reader-booked corruption events (which bypass the
+      drivers' per-failure checks) abort the ingest promptly instead
+      of salvage-scanning the whole file first.
+    """
+    sink = SalvageSink(metrics) if getattr(cfg, "salvage", False) \
+        else None
+    it = iter(stream)
+    while True:
+        if guard is not None and guard.requested:
+            return
+        try:
+            z = next(it)
+        except StopIteration:
+            return
+        except CorruptionError as e:
+            if sink is None:
+                raise
+            sink.record(e.reason)
+            print(f"[ccsx-tpu] salvage: classified corruption from the "
+                  f"stream ({e.reason}: {e}); ending ingestion — "
+                  "emitting what was salvaged", file=sys.stderr)
+            return
+        try:
+            faultinject.fire("input_corrupt")
+        except CorruptionError as e:
+            if sink is None:
+                raise
+            sink.record(e.reason)
+            print(f"[ccsx-tpu] salvage: dropped corrupt input unit "
+                  f"({e.reason}: {e})", file=sys.stderr)
+            continue
+        # count-form budgets abort mid-ingest (fractions settle at end
+        # of run where the denominator is final)
+        check_failure_budget(metrics, cfg)
+        yield z
 
 
 def holes_total_hint(in_path: str, cfg: CcsConfig):
@@ -81,6 +161,11 @@ class _PyWriter:
         self.bytes_out = start_bytes
 
     def put(self, name: str, seq: bytes, qual: bytes | None = None) -> None:
+        # disk_full fault point (ENOSPC): fires BEFORE any bytes land,
+        # so the journaled offset stays behind the durable output and a
+        # resume recomputes the interrupted hole (no torn record past
+        # the cursor)
+        faultinject.fire("disk_full")
         rec, nbytes = fastx.format_record(name, seq, qual)
         self._f.write(rec)
         self.bytes_out += nbytes
@@ -204,12 +289,21 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
         # flush-before-cursor + write fault point + advance: the shared
         # crash invariant lives in Journal.retire
         journal.retire(writer, wrote, metrics)
+        # deterministic drain testing: a real SIGTERM delivered at a
+        # retirement point (the graceful-drain acceptance case)
+        faultinject.fire("sigterm")
         metrics.tick()
 
     rc = 0
     pool = ThreadPoolExecutor(max_workers=max(cfg.threads, 1)) \
         if cfg.threads > 1 else None
     pending = collections.deque()
+    # graceful drain (utils/drain.py): SIGTERM/SIGINT stop admission;
+    # in-flight holes finish, writer + journal settle, rc 75 resumable
+    from ccsx_tpu.utils.drain import DrainGuard
+
+    guard = DrainGuard.install()
+    stream = guarded_stream(stream, cfg, metrics, guard)
     # flight recorder: the per-hole path has no batched device-dispatch
     # spans for the watchdog to watch (host compute dominates), but the
     # span trace — ingest, per-hole compute (worker threads included),
@@ -263,8 +357,10 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
             with metrics.timer("compute"):
                 item = pending.popleft().result()
             write_result(item)
-        # fraction-form --max-failed-holes settles at end of run
-        check_failure_budget(metrics, cfg, final=True)
+        # fraction-form --max-failed-holes settles at end of run — but
+        # not on a drain: the denominator is a partial run's
+        if not guard.requested:
+            check_failure_budget(metrics, cfg, final=True)
     except FailureBudgetExceeded as e:
         from ccsx_tpu import exitcodes
 
@@ -278,6 +374,7 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
         print(f"Error: write failed: {e}", file=sys.stderr)
         rc = 1
     finally:
+        guard.restore()
         if pool is not None:
             pool.shutdown(wait=True)
         try:
@@ -296,4 +393,10 @@ def run_pipeline(in_path: str, out_path: str, cfg: CcsConfig,
         if telem is not None:
             telem.close()
         metrics.report()
+    if rc == 0 and guard.requested:
+        from ccsx_tpu import exitcodes
+
+        print("[ccsx-tpu] drained cleanly; resume with the same "
+              "command to continue", file=sys.stderr)
+        rc = exitcodes.RC_INTERRUPTED
     return rc

@@ -53,6 +53,20 @@ Points and their actions (each placed at ONE spot in the pipeline):
               are rate-limited (utils/journal.py fsync_interval_s); set
               CCSX_JOURNAL_FSYNC_S=0 for a deterministic per-advance
               schedule
+  input_corrupt  raise a classified CorruptionError (reason
+              "injected") at the stream read — with --salvage the
+              drivers book a corrupt hole and continue (the salvage
+              rung, drivable without a crafted file); without it, the
+              clean rc-1 invalid-input path
+  disk_full   raise OSError(ENOSPC) inside the synchronous output
+              writer's put — the disk-full reality: the run must exit
+              through the clean rc-1 path with the journal consistent
+              (no traceback, no torn record past the journaled
+              offset), and a resume must complete byte-identical
+  sigterm     deliver a real SIGTERM to this process at a hole
+              retirement (signal.raise_signal, so the drivers'
+              graceful-drain handler runs exactly as it would for an
+              external kill) — deterministic drain-and-resume testing
 
 The hard exits use ``os._exit`` (no atexit, no finally blocks, writer
 not closed) to model SIGKILL as closely as a same-process mechanism can.
@@ -65,7 +79,8 @@ import threading
 from typing import Dict, Optional
 
 POINTS = ("ingest", "compute", "device_oom", "stall", "device_hang",
-          "rank_death", "write", "journal")
+          "rank_death", "write", "journal", "input_corrupt",
+          "disk_full", "sigterm")
 
 # exit code of the write/journal crash actions — distinctive, so a test
 # (or an operator) can tell an injected kill from a real failure
@@ -158,6 +173,24 @@ def fire(point: str) -> None:
           file=sys.stderr)
     if point == "ingest":
         raise ValueError(f"injected ingest fault (faultinject, call {n})")
+    if point == "input_corrupt":
+        # deferred import: corruption.py must stay importable without
+        # this module's side effects and vice versa
+        from ccsx_tpu.io.corruption import CorruptionError
+
+        raise CorruptionError(
+            "injected",
+            f"injected input corruption (faultinject, call {n})")
+    if point == "disk_full":
+        import errno
+
+        raise OSError(errno.ENOSPC,
+                      f"No space left on device (injected, call {n})")
+    if point == "sigterm":
+        import signal
+
+        signal.raise_signal(signal.SIGTERM)
+        return
     if point == "compute":
         raise RuntimeError(
             f"injected compute fault (faultinject, call {n})")

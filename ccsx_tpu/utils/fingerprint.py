@@ -44,6 +44,16 @@ _NON_SEMANTIC = frozenset({
     # refused as a config change
     "dispatch_deadline_s", "breaker_strikes", "breaker_window_s",
     "breaker_probe_s", "max_failed_holes",
+    # hostile-input salvage (io/corruption.py): on the bytes a resume
+    # re-reads, salvage changes nothing until the first corrupt byte —
+    # exactly where a fail-fast run died — so the canonical recovery
+    # move ("it died on a corrupt block; re-run WITH --salvage and
+    # resume") must not be refused as a config change.  The emitted
+    # prefix is byte-identical either way (pinned by test_salvage).
+    # max_record_bytes stays SEMANTIC: it redefines which healthy
+    # records are accepted, so resuming across a change would splice
+    # sections read under different acceptance rules.
+    "salvage",
 })
 
 

@@ -247,9 +247,22 @@ class Journal:
 
     def close(self) -> None:
         """Settle any in-memory cursor progress onto disk (drivers call
-        this at run end, after the writer closes)."""
+        this at run end, after the writer closes).
+
+        A failed settle (ENOSPC on a full disk — the very failure that
+        may have ended the run) is a WARNING, not a raise: the
+        on-disk journal is merely further behind the durable output,
+        which is exactly the torn-tail state resume repairs.  Raising
+        from the drivers' ``finally`` would replace the real rc with a
+        traceback."""
         if self.path and self._pending:
-            self._write()
+            try:
+                self._write()
+            except OSError as e:
+                print(f"[ccsx-tpu] journal {self.path}: final settle "
+                      f"failed ({e}); the on-disk cursor lags the "
+                      "output — resume will truncate and recompute the "
+                      "tail", file=sys.stderr)
 
     def _write(self) -> None:
         # the injected crash fires between the fsynced tmp and the

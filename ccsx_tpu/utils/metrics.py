@@ -69,12 +69,21 @@ def check_failure_budget(metrics: "Metrics", cfg, final: bool = False):
     budget = getattr(cfg, "max_failed_holes", None)
     if budget is None:
         return
-    failed = metrics.holes_failed
+    # corrupt holes (salvage-mode input damage) spend the same budget
+    # as quarantined ones: both are holes the output will not carry.
+    # Structural-only events (corruption.NON_BUDGET_REASONS, e.g. a
+    # missing BGZF EOF marker on an otherwise-complete file) degrade
+    # the run but lose no hole, so they must not rc-2 a full output
+    from ccsx_tpu.io.corruption import NON_BUDGET_REASONS
+
+    corrupt = metrics.holes_corrupt - sum(
+        metrics.corrupt_reasons.get(r, 0) for r in NON_BUDGET_REASONS)
+    failed = metrics.holes_failed + max(corrupt, 0)
     if not 0 < budget < 1:   # absolute count (0 = abort on any failure)
         if failed > int(budget):
             raise FailureBudgetExceeded(
                 f"failed-hole budget exceeded: {failed} holes failed "
-                f"(--max-failed-holes {int(budget)})")
+                f"or corrupt (--max-failed-holes {int(budget)})")
         return
     total = metrics.holes_total
     if total and failed > budget * total:
@@ -112,6 +121,15 @@ class Metrics:
     # (native/io.py, ccsx_filter_counts)
     holes_filtered: int = 0
     filtered_reasons: dict = dataclasses.field(default_factory=dict)
+    # salvage-mode ingest (io/corruption.py, --salvage): classified
+    # input-corruption events the readers resynced past (~ holes lost
+    # to damage), with per-reason buckets from the pinned taxonomy.
+    # Fed by both reader stacks (Python sinks live; the native reader
+    # polls an atomic event count live + reason buckets at EOF) and by
+    # the drivers' injected-fault rung.  Counts toward the
+    # --max-failed-holes budget and marks the run degraded.
+    holes_corrupt: int = 0
+    corrupt_reasons: dict = dataclasses.field(default_factory=dict)
     windows: int = 0
     pair_alignments: int = 0   # batched prep strand_match pairs
     device_dispatches: int = 0
@@ -394,6 +412,7 @@ class Metrics:
             "holes_out": self.holes_out,
             "holes_failed": self.holes_failed,
             "holes_filtered": self.holes_filtered,
+            "holes_corrupt": self.holes_corrupt,
             "stalls": self.stalls,
             "windows": self.windows,
             "pair_alignments": self.pair_alignments,
@@ -460,6 +479,8 @@ class Metrics:
             # dict() copy: the telemetry thread snapshots while the
             # ingest loop may be inserting a new reason bucket
             snap["filtered_reasons"] = dict(self.filtered_reasons)
+        if self.corrupt_reasons:
+            snap["corrupt_reasons"] = dict(self.corrupt_reasons)
         if self.breaker_strike_log:
             # list() copy: the breaker publishes a fresh list per
             # strike, but a scraper could catch the reassignment

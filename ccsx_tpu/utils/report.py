@@ -68,6 +68,7 @@ REPORT_TILE_KEYS = (
 # the circuit breaker's verdict ride every run report)
 REPORT_HEADER_KEYS = (
     "holes_in", "holes_out", "holes_failed", "holes_filtered",
+    "holes_corrupt",
     "windows", "device_dispatches", "oom_resplits", "host_fallbacks",
     "device_hangs", "breaker_trips", "breaker_state",
     "stalls", "elapsed_s", "ingest_bytes",

@@ -58,7 +58,8 @@ PORT_TRIES = 32
 # ---- the schema contract (see module docstring) ---------------------------
 # snapshot keys exported to Prometheus as monotone counters
 PROM_COUNTERS = (
-    "holes_in", "holes_out", "holes_failed", "holes_filtered", "stalls",
+    "holes_in", "holes_out", "holes_failed", "holes_filtered",
+    "holes_corrupt", "stalls",
     "windows", "pair_alignments", "device_dispatches", "refine_overflows",
     "oom_resplits", "host_fallbacks", "compile_fallbacks",
     # resilient execution (pipeline/resilience.py): abandoned
@@ -81,8 +82,8 @@ PROM_GAUGES = (
 )
 # snapshot keys with dedicated (non-scalar) renderings
 PROM_STRUCTURED = ("groups", "groups_forced", "degraded", "progress",
-                   "filtered_reasons", "breaker_state",
-                   "breaker_strike_log")
+                   "filtered_reasons", "corrupt_reasons",
+                   "breaker_state", "breaker_strike_log")
 # per-group table fields exported as ccsx_group_<field>{group="..."}
 GROUP_FIELDS = ("compiles", "compile_s", "execute_s", "dispatches",
                 "dp_cells", "dp_cells_per_sec")
@@ -91,13 +92,15 @@ PROGRESS_KEYS = ("done", "total", "rate_zmws_per_sec", "elapsed_s",
                  "pct", "eta_s")
 # snapshot counters `top` SUMS across ranks
 TOP_SUM_KEYS = (
-    "holes_in", "holes_out", "holes_failed", "holes_filtered", "stalls",
+    "holes_in", "holes_out", "holes_failed", "holes_filtered",
+    "holes_corrupt", "stalls",
     "windows", "device_dispatches", "oom_resplits", "host_fallbacks",
     "refine_overflows", "device_hangs", "breaker_trips", "ingest_bytes",
 )
 # /healthz detail fields (rc-relevant: what an operator triages by)
 HEALTH_DETAIL_KEYS = ("stalls", "oom_resplits", "host_fallbacks",
-                      "holes_failed", "compile_fallbacks",
+                      "holes_failed", "holes_corrupt",
+                      "compile_fallbacks",
                       "refine_overflows", "device_hangs",
                       "breaker_trips", "breaker_state")
 
@@ -144,6 +147,11 @@ def render_prometheus(snap: dict, gauges: Optional[dict] = None) -> str:
         sample(f"progress_{key}", prog.get(key), "gauge")
     for reason, n in sorted((snap.get("filtered_reasons") or {}).items()):
         sample("filtered_reason", n, "counter",
+               labels=f'{{reason="{_prom_escape(reason)}"}}')
+    # salvage-mode input corruption, bucketed by the pinned taxonomy
+    # (io/corruption.py REASONS)
+    for reason, n in sorted((snap.get("corrupt_reasons") or {}).items()):
+        sample("corrupt_reason", n, "counter",
                labels=f'{{reason="{_prom_escape(reason)}"}}')
     for gkey, st in sorted((snap.get("groups") or {}).items()):
         labels = f'{{group="{_prom_escape(gkey)}"}}'

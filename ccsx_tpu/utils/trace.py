@@ -93,7 +93,8 @@ OCCUPANCY_KEYS = ("dp_occupancy", "dp_round_occupancy", "dp_length_fill",
 # schema-guarded like OCCUPANCY_KEYS (tests/test_telemetry.py)
 RESILIENCE_KEYS = ("device_hangs", "breaker_state", "breaker_trips",
                    "breaker_probes", "host_fallbacks", "oom_resplits",
-                   "compile_fallbacks", "holes_failed", "stalls")
+                   "compile_fallbacks", "holes_failed", "holes_corrupt",
+                   "stalls")
 
 _current: Optional["Tracer"] = None
 
@@ -835,10 +836,12 @@ def format_summary(d: dict) -> str:
             f"{k}={v}" for k, v in d["occupancy"].items()))
     res = d.get("resilience") or {}
     # only worth a line when something actually happened (hangs, trips,
-    # fallbacks, quarantines) or the breaker is not in its rest state
+    # fallbacks, quarantines, salvaged input corruption) or the breaker
+    # is not in its rest state
     if res and (any(res.get(k) for k in
                     ("device_hangs", "breaker_trips", "host_fallbacks",
-                     "oom_resplits", "holes_failed", "stalls"))
+                     "oom_resplits", "holes_failed", "holes_corrupt",
+                     "stalls"))
                 or res.get("breaker_state", "closed") != "closed"):
         lines.append("resilience recap: " + "  ".join(
             f"{k}={v}" for k, v in res.items()

@@ -38,14 +38,17 @@ def _chaos_env(monkeypatch):
 
 def test_chaos_fast_slice(tmp_path):
     """The deterministic tier-1 slice: 3 seeded in-process fault trials
-    on a 3-hole corpus, every one byte-identical to the fault-free
-    run.  Failures print the full per-trial detail (seeded: any red
-    trial is replayable with the same seed)."""
+    plus the input-plane pair (disk_full ENOSPC + resume,
+    input_corrupt under --salvage) on a 3-hole corpus, every one
+    holding its oracle.  Failures print the full per-trial detail
+    (seeded: any red trial is replayable with the same seed)."""
     summary = chaos.run_trials(seed=0, trials=3, holes=3,
                                include_kills=False,
                                include_shepherd=False,
                                tmp=str(tmp_path))
-    assert summary["n_trials"] == 3
+    assert summary["n_trials"] == 5
+    kinds = {t["kind"] for t in summary["trials"]}
+    assert "disk_full_resume" in kinds and "input_corrupt" in kinds
     assert summary["ok"], summary["trials"]
     # the seeded schedule is deterministic: same seed, same specs
     again = chaos.run_trials(seed=0, trials=3, holes=3,

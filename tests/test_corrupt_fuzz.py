@@ -1,0 +1,95 @@
+"""Seeded corruption fuzzer (benchmarks/corrupt.py) through the full
+CLI: the salvage invariant as an executable contract.
+
+Per mutant: no crash, no hang (every run is dispatch-deadlined), rc
+from the pinned exit-code taxonomy, and with --salvage every hole
+whose bytes are UNDAMAGED emits byte-identical to the clean run (the
+fuzzer's layout maps each mutation's blast radius to the exact hole
+set it may legally affect — text spans directly, BGZF through the
+block table).
+
+The FAST deterministic slice runs in tier-1 (`make fuzz` runs exactly
+this file's not-slow tests); the full >= 50-mutants-per-format sweep
+is the `slow` mark and the benchmarks/corrupt.py CLI.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks"))
+
+import corrupt  # noqa: E402
+
+from ccsx_tpu.utils import faultinject  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faultinject.disarm()
+    yield
+    faultinject.disarm()
+
+
+def test_fuzz_fast_slice(tmp_path):
+    """2 seeded mutants per format (+ the clean-input salvage
+    byte-identity check per format) through the full CLI.  Seeded:
+    any red mutant replays with the same seed."""
+    summary = corrupt.run_sweep(seed=0, mutants=2, tmp=str(tmp_path))
+    assert summary["n_trials"] == 3 * (2 + 1)
+    assert summary["ok"], summary["failed"]
+    # determinism: the same seed draws the same mutation schedule
+    again = corrupt.run_sweep(seed=0, mutants=2, tmp=str(tmp_path))
+    assert [r["mutation"] for r in again["failed"]] == []
+    assert summary["elapsed_s"] >= 0
+
+
+def test_damage_mapping_bgzf(tmp_path):
+    """The oracle itself: a mutation inside one BGZF block damages
+    exactly the holes whose records overlap that block — not the whole
+    file (which would make the invariant vacuous)."""
+    rng = np.random.default_rng(3)
+    corpus = corrupt.build_corpus(str(tmp_path), "bam", rng, holes=4,
+                                  template_len=6000, n_passes=5)
+    assert len(corpus.blocks) >= 3, "corpus must span multiple blocks"
+    blk = corpus.blocks[1]
+    mut = corrupt.Mutation("flip", blk[0] + 30, blk[0] + 31, "t")
+    dam = corrupt.damaged_holes(corpus, mut)
+    assert 0 < len(dam) < len(corpus.hole_spans), \
+        f"blast radius should be partial, got {dam}"
+    # a flip inside the EOF marker damages nothing
+    eof = corpus.blocks[-1]
+    mut = corrupt.Mutation("flip", eof[0] + 5, eof[0] + 6, "t")
+    assert corrupt.damaged_holes(corpus, mut) == set()
+    # truncation damages everything from its block on
+    mut = corrupt.Mutation("truncate", blk[0] + 10, len(corpus.data),
+                           "t")
+    dam = corrupt.damaged_holes(corpus, mut)
+    assert dam  # at least the tail holes
+    lo = min(corpus.hole_spans[h][0] for h in dam)
+    for h, (s0, s1) in corpus.hole_spans.items():
+        if s1 <= lo:
+            assert h not in dam
+
+
+def test_damage_mapping_text(tmp_path):
+    rng = np.random.default_rng(4)
+    corpus = corrupt.build_corpus(str(tmp_path), "fastq", rng, holes=4)
+    holes = sorted(corpus.hole_spans)
+    lo, hi = corpus.hole_spans[holes[1]]
+    mut = corrupt.Mutation("zeros", lo + 5, lo + 20, "t")
+    assert corrupt.damaged_holes(corpus, mut) == {holes[1]}
+
+
+@pytest.mark.slow
+def test_fuzz_full_sweep(tmp_path):
+    """The acceptance sweep: >= 50 mutants per format through the full
+    CLI — zero crashes/hangs, taxonomy rcs, salvage invariant on every
+    undamaged hole."""
+    summary = corrupt.run_sweep(seed=0, mutants=50, tmp=str(tmp_path))
+    assert summary["n_trials"] >= 3 * 50
+    assert summary["ok"], summary["failed"]

@@ -191,11 +191,19 @@ def test_ingest_fault_clean_rc1(corpus, tmp_path, capsys):
 def test_injected_oom_resplit_output_identical(corpus, tmp_path, capsys):
     """A device OOM on a multi-request shape group bisects and retries
     at smaller Z; the output must be byte-identical to the no-fault run
-    (per-request results are Z-invariant: padding is masked)."""
+    (per-request results are Z-invariant: padding is masked).
+
+    Inline prep + a pinned admission window: with the background prep
+    pool, the first sweep dispatches however many holes prep delivered
+    in time — sometimes ONE, whose group cannot resplit (it goes
+    straight to host replay) — so the multi-request-group premise was
+    a coin flip.  Inline admission fills the window before the first
+    sweep, deterministically."""
     fa, ref = corpus
     out = tmp_path / "o.fa"
     faultinject.arm("device_oom@1")
     assert cli.main(["-A", "-m", "1000", "--batch", "on",
+                     "--inflight", "8", "--prep-threads", "0",
                      str(fa), str(out)]) == 0
     assert out.read_bytes() == ref.read_bytes()
     assert "resplitting" in capsys.readouterr().err

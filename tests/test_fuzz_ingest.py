@@ -232,6 +232,169 @@ def test_fuzz_targeted_bgzf_corruptions(tmp_path):
     assert nat_err is None and len(nat) == 12
 
 
+# ---- salvage-mode differential parity (ISSUE 10) -------------------------
+#
+# Salvage resync is a SHARED contract: io/corruption.py's reason codes,
+# BGZF block-rescan rules, and plausible-record scan are implemented
+# twice (Python + io_native.cpp) and must classify each mutant with the
+# same reason buckets and salvage the SAME hole set.  These tests run a
+# seeded mutant corpus through both stacks' full ZMW streamers with
+# salvage on and hold them to exact equality — holes, passes, bytes,
+# and per-reason corruption counts.
+
+
+def _drain_salvage_native(path, cfg):
+    from ccsx_tpu.native.io import stream_zmws_native
+    from ccsx_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    holes = [(z.movie, z.hole, tuple(int(x) for x in z.lens), z.seqs)
+             for z in stream_zmws_native(str(path), cfg, metrics=m)]
+    return holes, m.corrupt_reasons, m.holes_corrupt
+
+
+def _drain_salvage_python(path, cfg):
+    from ccsx_tpu.io import zmw as zmw_mod
+    from ccsx_tpu.io.corruption import SalvageSink
+    from ccsx_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    sink = SalvageSink(m)
+    if cfg.is_bam:
+        records = bam_mod.read_bam_records(str(path), salvage=sink)
+    else:
+        records = fastx.read_fastx(str(path), salvage=sink)
+    holes = [(z.movie, z.hole, tuple(int(x) for x in z.lens), z.seqs)
+             for z in zmw_mod.stream_zmws(records, cfg, metrics=m,
+                                          salvage=sink)]
+    return holes, m.corrupt_reasons, m.holes_corrupt
+
+
+def _salvage_parity_corpus(tmp_path, data, ext, is_bam, n, seed,
+                           require_events=True):
+    from ccsx_tpu.config import CcsConfig
+
+    cfg = CcsConfig(min_subread_len=1, is_bam=is_bam, salvage=True)
+    rng = np.random.default_rng(seed)
+    n_events = 0
+    for i in range(n):
+        mut = bytearray(data)
+        kind = i % 3
+        if kind == 0:
+            pos = int(rng.integers(0, len(data)))
+            mut[pos] ^= 1 << int(rng.integers(0, 8))
+        elif kind == 1:
+            mut = mut[:int(rng.integers(1, len(data)))]
+        else:
+            pos = int(rng.integers(0, max(len(data) - 64, 1)))
+            ln = int(rng.integers(4, 64))
+            mut[pos:pos + ln] = b"\x00" * min(ln, len(mut) - pos)
+        p = tmp_path / f"s{i}.{ext}"
+        p.write_bytes(bytes(mut))
+        nat = _drain_salvage_native(p, cfg)
+        py = _drain_salvage_python(p, cfg)
+        assert nat[0] == py[0], \
+            f"salvaged hole sets diverge on mutant {i} ({ext})"
+        assert nat[1] == py[1], \
+            f"reason buckets diverge on mutant {i} ({ext}): " \
+            f"native {nat[1]} python {py[1]}"
+        assert nat[2] == py[2]
+        n_events += nat[2]
+    # the corpus must actually have exercised salvage, not parsed clean
+    if require_events:
+        assert n_events > 0
+
+
+def test_salvage_parity_bgzf_bam(tmp_path):
+    """18 seeded BGZF BAM mutants: both stacks salvage the same holes
+    with the same reason buckets (block rescans + record scans)."""
+    base = tmp_path / "base.bam"
+    # 6 records/hole so holes clear the default pass filter
+    recs = []
+    rng = np.random.default_rng(11)
+    for i in range(120):
+        ln = int(rng.integers(150, 400))
+        seq = rng.choice(list(b"ACGT"), ln).astype(np.uint8).tobytes()
+        recs.append((f"mv/{i // 6}/{i}_{i + ln}", seq, b"I" * ln))
+    bam_mod.write_bam(str(base), recs, bgzf=True)
+    _salvage_parity_corpus(tmp_path, base.read_bytes(), "bam", True,
+                           18, 5000)
+
+
+def test_salvage_parity_fastq(tmp_path):
+    """18 seeded FASTQ mutants: same salvage semantics on the text
+    state machine (qual mismatch classification + line-anchored
+    resync)."""
+    _salvage_parity_corpus(tmp_path, _fastq_bytes(n=36, seed=6), "fq",
+                           False, 18, 6000)
+
+
+def test_salvage_parity_fasta(tmp_path):
+    """12 seeded multi-line FASTA mutants + one deterministic bad-name
+    mutant (plain FASTA has no checksums, so random damage often
+    parses clean — the crafted mutant guarantees the zmw_bad_name
+    path is compared)."""
+    from ccsx_tpu.config import CcsConfig
+
+    data = _fasta_bytes(n=30, seed=7)
+    _salvage_parity_corpus(tmp_path, data, "fa", False, 12, 7000,
+                           require_events=False)
+    mut = data.replace(b">mv/4/", b">mvx4x", 1)
+    p = tmp_path / "badname.fa"
+    p.write_bytes(mut)
+    cfg = CcsConfig(min_subread_len=1, is_bam=False, salvage=True)
+    nat = _drain_salvage_native(p, cfg)
+    py = _drain_salvage_python(p, cfg)
+    assert nat == py
+    assert nat[1] == {"zmw_bad_name": 1}
+
+
+def test_salvage_resync_blank_line_before_header(tmp_path):
+    """A blank line between a damaged quality section and the next
+    record header: the line-anchored resync must skip it and keep the
+    header (the native scan once swallowed the whole next line after a
+    bare newline, silently dropping a healthy record — review find)."""
+    from ccsx_tpu.config import CcsConfig
+
+    fq = (b"@mv/1/0_8\nACGTACGT\n+\nIIIIIIIII\n"   # qual 9 > seq 8
+          b"\n"                                     # blank line
+          + b"".join(b"@mv/1/%d_%d\nACGTACGT\n+\nIIIIIIII\n"
+                     % (i, i + 8) for i in range(8, 48, 8)))
+    p = tmp_path / "blank.fq"
+    p.write_bytes(fq)
+    cfg = CcsConfig(min_subread_len=1, is_bam=False, salvage=True)
+    nat = _drain_salvage_native(p, cfg)
+    py = _drain_salvage_python(p, cfg)
+    assert nat == py
+    assert [(h[1], len(h[2])) for h in nat[0]] == [("1", 5)]
+    assert nat[1] == {"fastx_qual_mismatch": 1}
+
+
+def test_failfast_reason_codes_agree(tmp_path):
+    """Fail-fast (salvage OFF) classification: when the native reader
+    errors, its reason code is a member of the pinned taxonomy, and a
+    clean-parse disagreement between the stacks is still forbidden."""
+    from ccsx_tpu.io.corruption import REASONS
+    from ccsx_tpu.native.io import NativeStreamError
+
+    base = tmp_path / "base.bam"
+    bam_mod.write_bam(str(base), _bam_records(n=24, seed=9), bgzf=True)
+    data = base.read_bytes()
+    n_classified = 0
+    for i in range(12):
+        mut = (_bitflip, _truncate, _splice)[i % 3](data, 9000 + i)
+        p = tmp_path / f"f{i}.bam"
+        p.write_bytes(mut)
+        nat_err, py_err = _check_parity(p, True, f"failfast[{i}]")
+        if isinstance(nat_err, NativeStreamError):
+            assert nat_err.reason in REASONS, \
+                f"unclassified native reason {nat_err.reason!r}"
+            n_classified += 1
+        if py_err is not None and hasattr(py_err, "reason"):
+            assert py_err.reason in REASONS
+    assert n_classified >= 3
+
+
 def test_fuzz_zmw_name_edge_cases(tmp_path):
     """Malformed movie/hole/region names kill the stream in the
     reference (seqio.h:168-172, returns -1 mid-file); both ZMW streamers

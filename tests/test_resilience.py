@@ -468,6 +468,7 @@ def test_exit_code_taxonomy_pinned():
     assert exitcodes.RC_OK == 0
     assert exitcodes.RC_FATAL == 1
     assert exitcodes.RC_FAILED_HOLES == 2
+    assert exitcodes.RC_INTERRUPTED == 75
     assert exitcodes.RC_INJECTED_KILL == faultinject.EXIT_CODE == 57
 
 
@@ -478,8 +479,10 @@ def test_exit_codes_documented():
     readme = open(os.path.join(_REPO, "README.md")).read()
     arch = open(os.path.join(_REPO, "ARCHITECTURE.md")).read()
     for doc, name in ((readme, "README"), (arch, "ARCHITECTURE")):
-        for row in ("| 0 |", "| 1 |", "| 2 |", "| 57 |"):
+        for row in ("| 0 |", "| 1 |", "| 2 |", "| 75 |", "| 57 |"):
             assert row in doc, f"{name} is missing exit-code row {row}"
     assert "--max-failed-holes" in readme
     assert "--dispatch-deadline" in readme
+    assert "--salvage" in readme
+    assert "--max-record-bytes" in readme
     assert "shepherd" in readme

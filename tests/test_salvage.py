@@ -1,0 +1,395 @@
+"""Hostile-input ingest plane (ISSUE 10): the pinned corruption
+taxonomy, --salvage mode end-to-end, graceful drain on SIGTERM/SIGINT,
+and disk-full hardening — the input leg of the resilience triad
+(device + rank closed in PR 7).
+
+Contracts pinned here:
+  * the reason-code taxonomy cannot drift (REASONS is frozen);
+  * --salvage OFF preserves fail-fast byte-identically, and --salvage
+    ON over a CLEAN input is also byte-identical (zero overhead when
+    healthy);
+  * a corrupt input under --salvage completes rc 0 marked degraded,
+    books holes_corrupt + per-reason buckets, and emits every
+    UNDAMAGED hole byte-identical to the clean run;
+  * corrupt holes spend the --max-failed-holes budget (rc 2);
+  * SIGTERM mid-run drains (admission stops, in-flight finishes,
+    journal settles, rc 75) and a resume reaches byte-identity;
+  * injected ENOSPC exits the clean rc-1 path with a consistent
+    journal, and a resume reaches byte-identity.
+"""
+
+import json
+import os
+import signal
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from ccsx_tpu import cli, exitcodes
+from ccsx_tpu.io import corruption
+from ccsx_tpu.utils import faultinject, synth
+from ccsx_tpu.utils.drain import DrainGuard
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faultinject.disarm()
+    yield
+    faultinject.disarm()
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    """6-hole FASTA corpus + its clean-run reference bytes (one
+    consensus run shared by every test in this module)."""
+    tmp = tmp_path_factory.mktemp("salvage")
+    rng = np.random.default_rng(0)
+    zs = [synth.make_zmw(rng, template_len=500, n_passes=5, movie="mv",
+                         hole=str(100 + h)) for h in range(6)]
+    fa = tmp / "in.fa"
+    fa.write_text(synth.make_fasta(zs))
+    ref = tmp / "ref.fa"
+    rc = cli.main(["-A", "-m", "1000", "--batch", "on", str(fa),
+                   str(ref)])
+    assert rc == 0
+    return fa, ref.read_bytes()
+
+
+def _by_hole(b: bytes) -> dict:
+    return {c.split("\n", 1)[0]: c for c in b.decode().split(">")[1:]}
+
+
+# ---------- taxonomy pinned ----------
+
+
+def test_reason_codes_pinned():
+    """The stable reason codes both reader stacks report — a rename or
+    removal is a cross-stack contract break and must fail loudly."""
+    assert corruption.REASONS == (
+        "bam_bad_header", "bgzf_bad_block", "bgzf_bad_deflate",
+        "bgzf_torn_tail", "bgzf_missing_eof", "gzip_truncated",
+        "bam_bad_record", "bam_record_oversize", "fastx_qual_mismatch",
+        "fastx_truncated", "zmw_bad_name", "injected")
+    assert corruption.NON_BUDGET_REASONS == ("bgzf_missing_eof",)
+    assert corruption.DEFAULT_MAX_RECORD_BYTES == 256 * 1024 * 1024
+    # the config default must agree with the taxonomy's bound (the CLI
+    # help and the native kDefaultMaxRecordBytes both quote it)
+    from ccsx_tpu.config import CcsConfig
+
+    assert CcsConfig().max_record_bytes == \
+        corruption.DEFAULT_MAX_RECORD_BYTES
+
+
+def test_corruption_error_is_value_error():
+    """Pre-taxonomy handlers (except ValueError / except BamError)
+    must keep catching classified errors."""
+    from ccsx_tpu.io.bam import BamError
+    from ccsx_tpu.io.fastx import FastxError
+    from ccsx_tpu.io.zmw import InvalidZmwName
+
+    for exc in (corruption.CorruptionError("injected", "x"),
+                BamError("x"), FastxError("fastx_truncated", "x"),
+                InvalidZmwName("x")):
+        assert isinstance(exc, ValueError)
+        assert exc.reason in corruption.REASONS
+
+
+def test_allocation_bound_rejects_before_allocating(tmp_path):
+    """A corrupt int32 record length past --max-record-bytes must
+    classify bam_record_oversize BEFORE any allocation happens."""
+    import struct
+
+    from ccsx_tpu.io import bam as bam_mod
+
+    recs = [(f"mv/1/{i}_{i+50}", b"ACGT" * 16, b"I" * 64)
+            for i in range(6)]
+    p = tmp_path / "t.bam"
+    bam_mod.write_bam(str(p), recs, bgzf=False)
+    import gzip
+
+    payload = bytearray(gzip.decompress(p.read_bytes()))
+    (l_text,) = struct.unpack_from("<i", payload, 4)
+    off = 8 + l_text + 4   # through n_ref (0 refs)
+    payload[off:off + 4] = struct.pack("<i", 1 << 30)  # 1 GiB "record"
+    p.write_bytes(gzip.compress(bytes(payload)))
+    with pytest.raises(bam_mod.BamError) as ei:
+        list(bam_mod.read_bam_records(str(p)))
+    assert ei.value.reason == "bam_record_oversize"
+    # salvage classifies the same way and survives
+    sink = corruption.SalvageSink()
+    got = list(bam_mod.read_bam_records(str(p), salvage=sink))
+    assert sink.reasons.get("bam_record_oversize", 0) >= 1
+    assert len(got) <= len(recs)
+
+
+def test_missing_eof_marker_is_budget_exempt(tmp_path):
+    """A healthy BGZF BAM that merely lost its EOF marker: salvage
+    emits every hole, books bgzf_missing_eof, and a --max-failed-holes
+    0 budget must NOT rc-2 the complete output (the reviewer-found
+    zero-loss trap).  Both stacks classify it the same way."""
+    from ccsx_tpu.config import CcsConfig
+    from ccsx_tpu.io import bam as bam_mod, zmw as zmw_mod
+    from ccsx_tpu.io.corruption import SalvageSink
+    from ccsx_tpu.native.io import stream_zmws_native
+    from ccsx_tpu.utils.metrics import (Metrics, check_failure_budget)
+
+    recs = [(f"mv/1/{i}_{i+80}", b"ACGT" * 20, b"I" * 80)
+            for i in range(6)]
+    p = tmp_path / "t.bam"
+    bam_mod.write_bam(str(p), recs, bgzf=True)
+    data = p.read_bytes()
+    p.write_bytes(data[:-len(bam_mod.BGZF_EOF)])
+
+    cfg = CcsConfig(min_subread_len=1, is_bam=True, salvage=True,
+                    max_failed_holes=0.0)
+    m = Metrics()
+    sink = SalvageSink(m)
+    py = list(zmw_mod.stream_zmws(
+        bam_mod.read_bam_records(str(p), salvage=sink), cfg, metrics=m,
+        salvage=sink))
+    assert len(py) == 1 and py[0].n_passes == 6   # nothing lost
+    assert m.corrupt_reasons == {"bgzf_missing_eof": 1}
+    check_failure_budget(m, cfg)                  # must NOT raise
+    check_failure_budget(m, cfg, final=True)
+    m2 = Metrics()
+    nat = list(stream_zmws_native(str(p), cfg, metrics=m2))
+    assert [(z.hole, z.n_passes) for z in nat] == \
+        [(z.hole, z.n_passes) for z in py]
+    assert m2.corrupt_reasons == {"bgzf_missing_eof": 1}
+
+
+def test_max_record_bytes_applies_without_salvage(tmp_path):
+    """The allocation bound is live on BOTH stacks with salvage OFF:
+    a record larger than --max-record-bytes classifies
+    bam_record_oversize instead of being allocated."""
+    from ccsx_tpu.config import CcsConfig
+    from ccsx_tpu.io import bam as bam_mod
+    from ccsx_tpu.native.io import (NativeStreamError,
+                                    stream_zmws_native)
+
+    seq = b"ACGT" * 4000   # 16 kB record > the 8 kB bound
+    recs = [(f"mv/1/{i}_{i+80}", seq, b"I" * len(seq))
+            for i in range(6)]
+    p = tmp_path / "t.bam"
+    bam_mod.write_bam(str(p), recs, bgzf=True)
+    cfg = CcsConfig(min_subread_len=1, is_bam=True,
+                    max_record_bytes=8192)
+    with pytest.raises(NativeStreamError) as ei:
+        list(stream_zmws_native(str(p), cfg))
+    assert ei.value.reason == "bam_record_oversize"
+    with pytest.raises(bam_mod.BamError) as ei:
+        list(bam_mod.read_bam_records(
+            str(p), max_record_bytes=cfg.max_record_bytes))
+    assert ei.value.reason == "bam_record_oversize"
+
+
+# ---------- salvage end-to-end through the CLI ----------
+
+
+def test_salvage_clean_input_byte_identical(corpus, tmp_path):
+    """Zero overhead when healthy: --salvage over a clean input is
+    byte-identical to the fail-fast run."""
+    fa, ref = corpus
+    out = tmp_path / "o.fa"
+    rc = cli.main(["-A", "-m", "1000", "--batch", "on", "--salvage",
+                   str(fa), str(out)])
+    assert rc == 0
+    assert out.read_bytes() == ref
+
+
+def test_salvage_corrupt_input_emits_undamaged_holes(corpus, tmp_path):
+    """A poisoned record: fail-fast dies rc 1; --salvage completes rc 0
+    degraded with the damaged hole's event booked and every undamaged
+    hole byte-identical."""
+    fa, ref = corpus
+    data = fa.read_bytes()
+    idx = data.find(b">mv/102/")
+    mut = data[:idx] + data[idx:].replace(b"/", b"x", 2)
+    bad = tmp_path / "bad.fa"
+    bad.write_bytes(mut)
+
+    out = tmp_path / "ff.fa"
+    rc = cli.main(["-A", "-m", "1000", "--batch", "on", str(bad),
+                   str(out)])
+    assert rc == exitcodes.RC_FATAL
+
+    m = tmp_path / "m.jsonl"
+    out = tmp_path / "sv.fa"
+    rc = cli.main(["-A", "-m", "1000", "--batch", "on", "--salvage",
+                   "--metrics", str(m), str(bad), str(out)])
+    assert rc == exitcodes.RC_OK
+    final = [json.loads(line) for line in open(m)][-1]
+    assert final["holes_corrupt"] == 1
+    assert final["corrupt_reasons"] == {"zmw_bad_name": 1}
+    assert final.get("degraded")
+    r, s = _by_hole(ref), _by_hole(out.read_bytes())
+    for name, rec in r.items():
+        if "/102/" not in name:
+            assert s.get(name) == rec, f"undamaged {name} changed"
+
+
+def test_corrupt_holes_spend_failure_budget(corpus, tmp_path):
+    """--max-failed-holes 0 + one salvaged corruption = rc 2: salvage
+    must not become a silent data-loss mode with a budget set."""
+    fa, _ = corpus
+    out = tmp_path / "o.fa"
+    rc = cli.main(["-A", "-m", "1000", "--batch", "on", "--salvage",
+                   "--max-failed-holes", "0",
+                   "--inject-faults", "input_corrupt@2",
+                   str(fa), str(out)])
+    assert rc == exitcodes.RC_FAILED_HOLES
+
+
+def test_salvage_knob_is_resume_compatible():
+    """'It died on a corrupt block — re-run WITH --salvage and resume'
+    must not be refused as a config change (fingerprint invariance) —
+    but changing --max-record-bytes redefines which healthy records
+    are ACCEPTED, so it must invalidate a resume."""
+    import dataclasses
+
+    from ccsx_tpu.config import CcsConfig
+    from ccsx_tpu.utils.fingerprint import run_fingerprint
+
+    base = CcsConfig()
+    assert run_fingerprint(base) == run_fingerprint(
+        dataclasses.replace(base, salvage=True))
+    assert run_fingerprint(base) != run_fingerprint(
+        dataclasses.replace(base, max_record_bytes=1 << 20))
+
+
+# ---------- graceful drain (SIGTERM/SIGINT) ----------
+
+
+def test_sigterm_drain_then_resume_byte_identical(corpus, tmp_path,
+                                                  monkeypatch):
+    """SIGTERM at the first retirement (small pinned window, inline
+    prep => admission genuinely stops early): rc 75, journal
+    consistent and PARTIAL, resume completes byte-identical."""
+    fa, ref = corpus
+    out, jp = tmp_path / "o.fa", tmp_path / "j.json"
+    monkeypatch.setenv("CCSX_JOURNAL_FSYNC_S", "0")
+    args = ["-A", "-m", "1000", "--batch", "on", "--inflight", "2",
+            "--prep-threads", "0", "--journal", str(jp), str(fa),
+            str(out)]
+    faultinject.arm("sigterm@1")
+    rc = cli.main(args)
+    faultinject.disarm()
+    assert rc == exitcodes.RC_INTERRUPTED == 75
+    j = json.loads(jp.read_text())
+    assert 0 < j["holes_done"] < 6, "drain should leave work behind"
+    rc = cli.main(args)
+    assert rc == 0
+    assert out.read_bytes() == ref
+
+
+def test_sigterm_drain_per_hole_driver(corpus, tmp_path, monkeypatch):
+    """The same contract on the per-hole (--batch off) driver."""
+    fa, ref = corpus
+    out, jp = tmp_path / "o.fa", tmp_path / "j.json"
+    monkeypatch.setenv("CCSX_JOURNAL_FSYNC_S", "0")
+    args = ["-A", "-m", "1000", "--batch", "off", "--journal", str(jp),
+            str(fa), str(out)]
+    faultinject.arm("sigterm@2")
+    rc = cli.main(args)
+    faultinject.disarm()
+    assert rc == exitcodes.RC_INTERRUPTED
+    assert 0 < json.loads(jp.read_text())["holes_done"] < 6
+    rc = cli.main(args)
+    assert rc == 0
+    assert out.read_bytes() == ref
+
+
+def test_drain_guard_sigint_and_restore():
+    """SIGINT sets the flag without raising KeyboardInterrupt, and
+    restore() reinstates the previous handlers."""
+    before = signal.getsignal(signal.SIGINT)
+    g = DrainGuard.install()
+    try:
+        signal.raise_signal(signal.SIGINT)   # handler, no KeyboardInterrupt
+        assert g.requested
+    finally:
+        g.restore()
+    assert signal.getsignal(signal.SIGINT) is before
+
+
+def test_drain_guard_second_signal_restores():
+    """A second signal during the drain hands control back to the
+    previous handlers (the operator's escape hatch)."""
+    before = signal.getsignal(signal.SIGTERM)
+    g = DrainGuard.install()
+    try:
+        signal.raise_signal(signal.SIGTERM)
+        assert g.requested
+        signal.raise_signal(signal.SIGTERM)  # restores previous handlers
+        assert signal.getsignal(signal.SIGTERM) is before
+    finally:
+        g.restore()
+
+
+def test_drain_guard_noop_off_main_thread():
+    """install() off the main thread degrades to an inert guard (signal
+    handlers are main-thread-only) instead of raising."""
+    import threading
+
+    res = {}
+
+    def t():
+        res["g"] = DrainGuard.install()
+
+    th = threading.Thread(target=t)
+    th.start()
+    th.join()
+    assert res["g"].requested is False
+    res["g"].restore()   # no-op, must not raise
+
+
+# ---------- disk-full hardening ----------
+
+
+def test_enospc_clean_rc1_then_resume(corpus, tmp_path, monkeypatch,
+                                      capsys):
+    """Injected ENOSPC at the writer: clean rc 1 (no traceback), the
+    journal never claims the unwritten record, and the resume
+    completes byte-identical."""
+    fa, ref = corpus
+    out, jp = tmp_path / "o.fa", tmp_path / "j.json"
+    monkeypatch.setenv("CCSX_JOURNAL_FSYNC_S", "0")
+    args = ["-A", "-m", "1000", "--batch", "on", "--journal", str(jp),
+            str(fa), str(out)]
+    faultinject.arm("disk_full@3")
+    rc = cli.main(args)
+    faultinject.disarm()
+    err = capsys.readouterr().err
+    assert rc == exitcodes.RC_FATAL
+    assert "No space left on device" in err
+    assert "Traceback" not in err
+    j = json.loads(jp.read_text())
+    assert j["holes_done"] < 6
+    # the journaled offset points at durable bytes only
+    assert j["out_bytes"] <= out.stat().st_size
+    rc = cli.main(args)
+    assert rc == 0
+    assert out.read_bytes() == ref
+
+
+def test_enospc_in_journal_settle_warns_not_raises(tmp_path, capsys):
+    """A failed final journal settle (disk still full in the drivers'
+    finally) must warn, not traceback — the on-disk cursor merely lags
+    the durable output."""
+    from ccsx_tpu.utils.journal import Journal
+
+    jp = tmp_path / "j.json"
+    j = Journal(path=str(jp), input_id="x", fsync_interval_s=3600.0)
+    j.advance()          # first advance writes (cold rate limiter)
+    j.advance()          # second is rate-limited: pending in memory
+    assert j._pending
+
+    def boom():
+        raise OSError(28, "No space left on device")
+
+    j._write_disk = boom
+    j.close()            # must not raise
+    assert "final settle failed" in capsys.readouterr().err

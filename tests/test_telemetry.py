@@ -344,9 +344,11 @@ def _populated_snapshot():
               "packed_dispatches", "packed_holes",
               "distinct_slab_shapes", "fused_waves",
               "fused_slabs_real", "fused_slots", "ingest_bytes",
-              "device_hangs", "breaker_trips", "breaker_probes"):
+              "device_hangs", "breaker_trips", "breaker_probes",
+              "holes_corrupt"):
         setattr(m, f, 7)
     m.filtered_reasons["few_passes"] = 7
+    m.corrupt_reasons["bgzf_bad_deflate"] = 7
     m.holes_total = 100
     m.degraded = "x"
     m.breaker_state = "open"
