@@ -4,7 +4,15 @@
 PY ?= python
 PYTEST_FLAGS = -q -p no:cacheprovider -p no:xdist -p no:randomly
 
-.PHONY: chaos chaos-soak fuzz fuzz-sweep tier1 native
+.PHONY: chaos chaos-soak fuzz fuzz-sweep tier1 native long-molecule
+
+# the long-template (ultra-long-read) A/B: prefilter + device seeding
+# vs the legacy host path, interleaved arms, bytes asserted identical
+# (also directly: python benchmarks/long_molecule.py --scenarios ...)
+long-molecule:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/long_molecule.py \
+	  --scenarios 4x50000,4x50000d4,1x100000d4 --passes 8 \
+	  --json benchmarks/long_molecule_r11.json
 
 # the deterministic tier-1 chaos slice (tests/test_chaos.py fast
 # tests): seeded fault schedules through the full CLI with the

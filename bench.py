@@ -71,6 +71,70 @@ def find_prev_bench(root=_HERE):
     return None, None
 
 
+def latest_quality_artifacts(root=_HERE, n=2):
+    """The ``n`` highest-numbered usable benchmarks/quality_r*.json
+    artifacts, newest first, as (name, summary) pairs.  A usable one
+    carries a gate_biased Q20 yield (the realistic-error regime,
+    ROADMAP item 5 — the product-defining number the bench trajectory
+    must gate alongside the perf ones)."""
+    import glob
+    import re
+
+    cands = []
+    for p in glob.glob(os.path.join(root, "benchmarks",
+                                    "quality_r*.json")):
+        m = re.search(r"quality_r(\d+)\.json$", p)
+        if m:
+            cands.append((int(m.group(1)), p))
+    out = []
+    for _, p in sorted(cands, reverse=True):
+        try:
+            with open(p) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            continue
+        gb = d.get("gate_biased")
+        g1 = d.get("gate_1")
+        gb_y = gb.get("q20_yield") if isinstance(gb, dict) else None
+        iid_y = g1.get("q20_yield") if isinstance(g1, dict) else None
+        if gb_y is None:
+            continue
+        out.append((os.path.basename(p),
+                    {"gate_biased_q20_yield": gb_y,
+                     "iid_q20_yield": iid_y}))
+        if len(out) >= n:
+            break
+    return out
+
+
+def compare_quality(line, prev, vp, regressed):
+    """The quality leg of the vs_prev gate: gate_biased Q20 yield from
+    the newest quality artifact vs the prior bench line's (or, before
+    bench lines carried one, the second-newest quality artifact).  A
+    >20% relative drop flags ``regressed`` exactly like a perf drop —
+    quality backsliding must trip the same wire (ROADMAP item 5 tail).
+    Yield is a bytes-level property, so no backend gating applies."""
+    quals = latest_quality_artifacts()
+    if quals:
+        name, summary = quals[0]
+        line["quality"] = {"artifact": name, **summary}
+    cur = (line.get("quality") or {}).get("gate_biased_q20_yield")
+    prev_q = ((prev or {}).get("quality")
+              or {}).get("gate_biased_q20_yield")
+    prev_src = "prev bench line"
+    if prev_q is None and len(quals) > 1:
+        prev_src, prev_q = quals[1][0], \
+            quals[1][1]["gate_biased_q20_yield"]
+    if cur is None or prev_q is None:
+        return
+    vp["gate_biased_q20_yield"] = {"prev": prev_q, "cur": cur,
+                                   "prev_source": prev_src}
+    if prev_q > 0 and cur < prev_q * REGRESSION_DROP:
+        regressed.append(
+            f"gate_biased q20_yield {prev_q}->{cur} (quality "
+            "regression, realistic-error regime)")
+
+
 def compare_with_prev(line, prev, artifact):
     """Mutates ``line``: adds "vs_prev" (ratios vs the prior artifact
     for dp_cells_per_sec and per-config e2e zmws_per_sec) and, on a
@@ -186,6 +250,8 @@ def compare_with_prev(line, prev, artifact):
             vp["zmws_per_sec_configs"] = ratios
             if g < REGRESSION_DROP:
                 regressed.append(f"e2e zmws_per_sec x{g:.2f}")
+    # the quality leg rides every comparison (backend-independent)
+    compare_quality(line, prev, vp, regressed)
     line["vs_prev"] = vp
     if regressed:
         line["regressed"] = regressed
@@ -524,10 +590,19 @@ def _inner_main():
     if prev is not None:
         compare_with_prev(line, prev, prev_art)
     else:
-        line["vs_prev"] = {"artifact": None,
-                           "note": "no prior BENCH_r*.json artifact; "
-                                   "vs_baseline reports the native "
-                                   "yardstick"}
+        vp = {"artifact": None,
+              "note": "no prior BENCH_r*.json artifact; vs_baseline "
+                      "reports the native yardstick"}
+        regressed = []
+        # the quality gate still applies: two quality artifacts can
+        # exist before any bench artifact does
+        compare_quality(line, None, vp, regressed)
+        line["vs_prev"] = vp
+        if regressed:
+            line["regressed"] = regressed
+            print("[bench] " + "!" * 20 + " QUALITY REGRESSION: "
+                  + "; ".join(regressed) + " " + "!" * 20,
+                  file=sys.stderr)
 
     print(json.dumps(line))
 

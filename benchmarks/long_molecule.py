@@ -1,0 +1,296 @@
+"""Long-template (ultra-long-read) benchmark: the pre-alignment plane's
+A/B (ISSUE 11 / ROADMAP item 4).
+
+The long-template regime is where per-pair host seeding and full-length
+strand_match DPs become the ceiling again: at >= 50kb a WRONG-strand
+doubtful pass slips past the legacy votes>=3 seed gate essentially
+always (measured 28-30/30) and pays a multi-second doomed banded DP
+before the RC arm even starts.  The prefilter (ops/sketch.py) kills
+that arm in one batched screen row, and --seed-device-min-t moves the
+surviving pairs' k-mer seeding off the host (ops/seed_device.py).
+
+Scenarios (each a synthetic FASTA through the full CLI, CPU fake
+device unless a real backend resolves):
+
+* ``NxL`` (default corpus: interrupted traversals) — N molecules at L
+  bases.  At ultra-long template lengths most polymerase traversals
+  terminate mid-pass (polymerase death / laser events; at 50-100kb the
+  per-traversal completion odds are well under half), so complete
+  passes arrive SEPARATED by short partial-pass fragments.  The corpus
+  models the adversarial-but-canonical form of that regime: every
+  third traversal completes (so complete passes still alternate
+  strand), the two between yield 12-40% head fragments.  Fragments
+  fall outside the template length group and are skipped by the walk
+  — but each one breaks strand-parity trust, so EVERY complete pass
+  is alignment-verified (the reference's main.c:392-406 walk at its
+  most expensive), fwd arm first; the ~half that are reverse-strand
+  are the doomed-DP population the prefilter exists for.
+* ``NxLdK`` — the partials corpus with a DOUBLY-LOADED well: K passes
+  from a second, unrelated molecule of in-group length (0.97x) are
+  interleaved into the back half of the subread stream.  ZMW loading
+  is Poisson, so two-molecule wells are a standing fraction of every
+  real run, and at ultra-long insert sizes each contaminant pass is
+  the filter's canonical hopeless pairing: it survives the legacy
+  votes>=3 chance-hit gate at these lengths and pays TWO full doomed
+  DPs (fwd then RC, both rejected) in the control arm, while the
+  sketch's noise gate kills both arms for the cost of a screen row.
+* ``NxLrt`` — the r04-style read-through corpus: regular passes plus
+  TWO read-through (missed-adapter) passes flanking the template pass.
+  Exercises the out-of-group path (where fwd+RC speculation must NOT
+  fire: a read-through carries both strands) and the 2x-template
+  query shapes.
+* the 100kb single-molecule scenario extends the r04 series (8kb/20kb)
+  two octaves: windows scale linearly, DP memory stays flat, and the
+  prep plane's share becomes visible.
+
+Both arms run with ``--slab-rows 32`` (artifact-recorded): the
+long-molecule regime has ~8 segment rows per hole, and the default
+128-row canonical slabs pad the window-refine plane to ~12% fill —
+right-sizing the slab is orthogonal tuning that makes the CONTROL arm
+faster too, so the prefilter win is measured against the strongest
+baseline, not a bloated one.
+
+Arms, interleaved A/B/A/B after one unmeasured warm lap each (the
+repo's timing hygiene: jit caches warm, arms alternate so drift hits
+both equally):
+
+* ``on``  — --prefilter on,  --seed-device-min-t <crossover>
+* ``off`` — --prefilter off, --seed-device-min-t 0  (the legacy path:
+  host argsort seeding, every doubtful arm pays its DP)
+
+Output bytes are asserted IDENTICAL between arms on every scenario
+(the conservativeness contract), and the artifact records per-arm wall
+plus the screen/seeding counters.
+
+Usage:
+  python benchmarks/long_molecule.py
+      [--scenarios 4x50000,4x50000d4,1x100000d4] [--passes 6]
+      [--laps 2] [--json benchmarks/long_molecule_rNN.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import re
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from ccsx_tpu import cli                                     # noqa: E402
+from ccsx_tpu.ops import encode as enc                       # noqa: E402
+from ccsx_tpu.utils import synth                             # noqa: E402
+
+ERR = dict(sub_rate=0.02, ins_rate=0.05, del_rate=0.05)
+# the partials (ultra-long) corpus runs a modern-chemistry ~5% per-pass
+# error mix: at 12% the pass-vs-pass indel random walk out-drifts the
+# +-64-diagonal band by 50kb (2*(ins+del)*L variance) and every
+# verification fails in BOTH arms — no real instrument pairs 12%
+# passes with 100kb templates
+ERR_LONG = dict(sub_rate=0.01, ins_rate=0.02, del_rate=0.02)
+
+ARMS = {
+    # crossover 16384 == the CLI default; spelled out so the artifact
+    # is self-describing
+    "on": ["--prefilter", "on", "--seed-device-min-t", "16384"],
+    "off": ["--prefilter", "off", "--seed-device-min-t", "0"],
+}
+
+
+def make_long_fasta(path: str, holes: int, tlen: int, n_passes: int,
+                    seed: int, corpus: str = "partials",
+                    dual: int = 0) -> None:
+    """``holes`` molecules at ``tlen``.
+
+    ``partials`` (default): ``n_passes`` COMPLETE traversals with two
+    interrupted traversals (12-40% head fragments, correct alternating
+    strand) between each consecutive pair — the ultra-long regime's
+    canonical shape, where parity trust never survives and every
+    complete pass is alignment-verified.  One complete traversal per
+    three keeps the complete passes themselves strand-alternating, so
+    ~half the verifications try the doomed wrong-strand arm first.
+
+    ``dual``: passes from a SECOND unrelated molecule (0.97x length —
+    in-group under the 10% clustering tolerance) inserted into the
+    back half of the stream, each right after a fragment so it lands
+    doubtful (alignment-verified) and late enough that the group's
+    median-by-index template pick stays on the first molecule.
+
+    ``rt``: the r04-style corpus — two read-through passes flanking
+    the (median) template pass."""
+    rng = np.random.default_rng(seed)
+    zs = []
+    for h in range(holes):
+        if corpus == "rt":
+            z = synth.make_zmw(rng, template_len=tlen,
+                               n_passes=n_passes, movie="mv",
+                               hole=str(h), **ERR)
+            mid = len(z.passes) // 2
+            for at in (max(mid - 1, 0), min(mid + 2, len(z.passes))):
+                z.passes.insert(at, synth.read_through(rng, z.template,
+                                                       **ERR))
+                z.strands.insert(at, 0)
+        else:
+            t = rng.integers(0, 4, tlen).astype(np.uint8)
+            passes, strands = [], []
+            n_trav = 3 * n_passes - 2
+            for trav in range(n_trav):
+                strand = trav % 2
+                p = synth.mutate(rng, t, **ERR_LONG)
+                if strand:
+                    p = enc.revcomp_codes(p)
+                if trav % 3:   # interrupted traversal: head fragment
+                    keep = int(len(p) * (0.12 + 0.28 * rng.random()))
+                    p = p[:max(keep, 1200)]
+                passes.append(p)
+                strands.append(strand)
+            if dual:
+                t2 = rng.integers(0, 4, int(tlen * 0.97)).astype(np.uint8)
+                # every contaminant pass sits just before the LAST
+                # complete traversal: late enough that the group's
+                # median-BY-INDEX template pick stays on the first
+                # molecule (spreading them earlier flipped the
+                # representative to the contaminant), and each lands
+                # doubtful — the first follows a fragment, the rest
+                # follow a rejected pass, and rejection keeps the
+                # walk's strand_adjust set
+                # in-group ids are [n-1 A's, K B's, last A]; the median
+                # ids[(n+K)//2] stays on an A pass iff K <= n-3
+                assert dual <= n_passes - 3, \
+                    "contaminant would capture the median template pick"
+                at = len(passes) - 1
+                for j in range(dual):
+                    p = synth.mutate(rng, t2, **ERR_LONG)
+                    if j % 2:
+                        p = enc.revcomp_codes(p)
+                    passes.insert(at, p)
+                    strands.insert(at, j % 2)
+            z = synth.SynthZmw(movie="mv", hole=str(h), template=t,
+                               passes=passes, strands=strands)
+        zs.append(z)
+    with open(path, "w") as f:
+        f.write(synth.make_fasta(zs))
+
+
+SLAB_ROWS = "32"   # right-sized for ~8-row holes (see module docstring)
+
+
+def run_arm(fa: str, tmp: str, tag: str, extra, metrics_keys=()) -> dict:
+    out = os.path.join(tmp, f"out_{tag}.fa")
+    mpath = os.path.join(tmp, f"m_{tag}.jsonl")
+    t0 = time.perf_counter()
+    # -M 4M: the read-step filter bounds TOTAL hole length (main.c:659
+    # semantics) and a 100kb molecule at 6+ passes crosses the 500k
+    # default — raising it is what "opening the ultra-long-read
+    # scenario" means at the CLI
+    rc = cli.main(["-A", "-m", "1000", "-M", "4000000", "--batch", "on",
+                   "--slab-rows", SLAB_ROWS,
+                   "--metrics", mpath, *extra, fa, out])
+    dt = time.perf_counter() - t0
+    assert rc == 0, f"arm {tag} rc={rc}"
+    final = [json.loads(ln) for ln in open(mpath)][-1]
+    md5 = hashlib.md5(open(out, "rb").read()).hexdigest()
+    rec = {"seconds": round(dt, 2), "md5": md5}
+    for k in metrics_keys:
+        rec[k] = final.get(k)
+    return rec
+
+
+COUNTER_KEYS = ("pair_alignments", "pairs_screened", "pairs_prefiltered",
+                "prefilter_share", "pairs_seeded_device",
+                "pairs_seeded_host", "windows", "prep_share",
+                "prep_blocked_s")
+
+
+def run_scenario(holes: int, tlen: int, n_passes: int, laps: int,
+                 seed: int, corpus: str = "partials",
+                 dual: int = 0) -> dict:
+    with tempfile.TemporaryDirectory() as tmp:
+        fa = os.path.join(tmp, "in.fa")
+        make_long_fasta(fa, holes, tlen, n_passes, seed, corpus=corpus,
+                        dual=dual)
+        # one unmeasured warm lap per arm (cold compiles amortize out),
+        # then `laps` interleaved measured laps per arm
+        warm = {a: run_arm(fa, tmp, f"warm_{a}", ARMS[a], COUNTER_KEYS)
+                for a in ARMS}
+        md5s = {a: warm[a]["md5"] for a in ARMS}
+        assert len(set(md5s.values())) == 1, \
+            f"ARMS NOT BYTE-IDENTICAL: {md5s}"
+        walls = {a: [] for a in ARMS}
+        for lap in range(laps):
+            for a in ARMS:
+                walls[a].append(
+                    run_arm(fa, tmp, f"l{lap}_{a}", ARMS[a])["seconds"])
+        best = {a: min(w) for a, w in walls.items()}
+        win = 1.0 - best["on"] / best["off"]
+        return {
+            "holes": holes, "template_len": tlen, "n_passes": n_passes,
+            "corpus": corpus, "dual_passes": dual,
+            "slab_rows": int(SLAB_ROWS),
+            "md5": next(iter(md5s.values())),
+            "arms": {a: {"walls_s": walls[a], "best_s": best[a],
+                         "counters": {k: warm[a][k]
+                                      for k in COUNTER_KEYS}}
+                     for a in ARMS},
+            "prefilter_win_pct": round(win * 100, 1),
+        }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scenarios", default="4x50000,4x50000d4,1x100000d4",
+                    help="comma list of HOLESxTLEN, optional 'rt' "
+                         "(read-through corpus) or 'dK' (doubly-loaded "
+                         "well, K contaminant passes) suffix "
+                         "[4x50000,4x50000d4,1x100000d4]")
+    ap.add_argument("--passes", type=int, default=6)
+    ap.add_argument("--laps", type=int, default=2,
+                    help="measured interleaved laps per arm [2]")
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--json", default=None)
+    a = ap.parse_args()
+
+    from ccsx_tpu.utils.device import resolve_device
+
+    resolve_device("auto")
+    import jax
+
+    out = {
+        "note": "pre-alignment plane A/B on the long-template regime: "
+                "--prefilter on + device seeding vs off + host seeding, "
+                "interleaved after a warm lap, bytes asserted identical "
+                "per scenario (see benchmarks/long_molecule.py)",
+        "backend": jax.default_backend(),
+        "seed": a.seed, "laps": a.laps,
+        "scenarios": [],
+    }
+    for spec in a.scenarios.split(","):
+        spec = spec.lower()
+        m = re.fullmatch(r"(\d+)x(\d+)(rt|d(\d+))?", spec)
+        assert m, f"bad scenario spec: {spec!r}"
+        holes, tlen = int(m.group(1)), int(m.group(2))
+        corpus = "rt" if m.group(3) == "rt" else "partials"
+        dual = int(m.group(4)) if m.group(4) else 0
+        print(f"[long_molecule] scenario {spec} ...", file=sys.stderr)
+        r = run_scenario(holes, tlen, a.passes, a.laps, a.seed,
+                         corpus=corpus, dual=dual)
+        print(f"[long_molecule] {spec}: on {r['arms']['on']['best_s']}s"
+              f" off {r['arms']['off']['best_s']}s"
+              f" win {r['prefilter_win_pct']}%", file=sys.stderr)
+        out["scenarios"].append(r)
+    s = json.dumps(out, indent=1)
+    print(s)
+    if a.json:
+        with open(a.json, "w") as f:
+            f.write(s + "\n")
+
+
+if __name__ == "__main__":
+    main()

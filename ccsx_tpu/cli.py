@@ -105,6 +105,21 @@ TPU extensions (long options):
                            device compute; 0 = inline prep on the
                            driver thread, the old behavior; output
                            bytes identical either way) [auto]
+--prefilter {on,off}      (device pre-alignment screen: one batched
+                           dispatch scores each wave of strand_match
+                           pair candidates and rejects hopeless ones
+                           before the banded DP — conservative by
+                           construction, output bytes identical
+                           either way; 'off' disables the screen and
+                           the walk's fwd+RC speculation — seeding
+                           routing stays with --seed-device-min-t)
+                           [on]
+--seed-device-min-t <n>   (host/device k-mer seeding crossover: pairs
+                           whose template is >= n bases seed on the
+                           device (ops/seed_device.py, bit-equal to
+                           the host sort-join); shorter pairs keep the
+                           cached host path.  0 disables device
+                           seeding) [16384]
 --pass-buckets a,b,...    (bucketed-grouping A/B control: disables pass
                            packing and pads passes to these buckets)
 --inject-faults p@N,...   (deterministic fault injection; testing only)
@@ -210,6 +225,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "compute; 0 = inline prep (the old behavior). "
                         "Output bytes are identical either way "
                         "[auto-size to the host]")
+    p.add_argument("--prefilter", default="on", choices=["on", "off"],
+                   dest="prefilter",
+                   help="device pre-alignment screen (ops/sketch.py): "
+                        "score each wave of strand_match pair "
+                        "candidates in one batched dispatch and "
+                        "reject hopeless ones before the banded DP. "
+                        "Conservative: output bytes are identical on "
+                        "or off (pinned); 'off' disables the screen "
+                        "and the walk's fwd+RC speculation (the A/B "
+                        "control — seeding routing is governed by "
+                        "--seed-device-min-t alone) [on]")
+    p.add_argument("--seed-device-min-t", type=int, default=None,
+                   dest="seed_device_min_t", metavar="N",
+                   help="host/device k-mer seeding crossover: pairs "
+                        "whose template is >= N bases use the batched "
+                        "device seeder (bit-equal to the host "
+                        "sort-join, ops/seed_device.py); shorter "
+                        "pairs keep the cached host path.  0 "
+                        "disables device seeding [16384]")
     p.add_argument("--fastq", action="store_true", dest="fastq",
                    help="Write FASTQ with per-base vote-margin qualities "
                         "instead of FASTA (extension; the reference "
@@ -407,6 +441,11 @@ def config_from_args(args) -> CcsConfig:
         print(f"Error: --prep-threads must be in [0, 64], got "
               f"{prep_threads}", file=sys.stderr)
         raise SystemExit(1)
+    seed_device_min_t = getattr(args, "seed_device_min_t", None)
+    if seed_device_min_t is not None and seed_device_min_t < 0:
+        print(f"Error: --seed-device-min-t must be >= 0, got "
+              f"{seed_device_min_t}", file=sys.stderr)
+        raise SystemExit(1)
     dispatch_deadline = getattr(args, "dispatch_deadline", 0.0) or 0.0
     if dispatch_deadline < 0:
         print(f"Error: --dispatch-deadline must be >= 0, got "
@@ -477,6 +516,9 @@ def config_from_args(args) -> CcsConfig:
         dispatch_deadline_s=dispatch_deadline,
         max_failed_holes=max_failed,
         salvage=bool(getattr(args, "salvage", False)),
+        prefilter=getattr(args, "prefilter", "on") != "off",
+        **({"seed_device_min_t": seed_device_min_t}
+           if seed_device_min_t is not None else {}),
         **({"max_record_bytes": max_record_bytes}
            if max_record_bytes is not None else {}),
         **({"breaker_strikes": breaker_strikes}

@@ -160,6 +160,28 @@ class CcsConfig:
     #   device compute instead of adding to it.  None = auto-size to
     #   the host; 0 = the old inline behavior (CLI --prep-threads).
     #   Output bytes are identical either way
+    # ---- pre-alignment plane (ops/sketch.py + ops/seed_device.py;
+    #      ROADMAP item 4: the RASSA/SeGraM filter-before-DP lineage) ----
+    prefilter: bool = True             # CLI --prefilter {on,off}: a
+    #   batched device screen scores every wave of strand_match pair
+    #   candidates (capped k-mer hits + best diagonal-window votes,
+    #   bit-equal to the host seed gate's statistics) and rejects
+    #   hopeless pairings BEFORE the banded DP — the long-template
+    #   regime's dominant waste (a wrong-strand 100kb pair passes the
+    #   legacy votes>=3 gate essentially always and pays a multi-second
+    #   doomed DP).  Rejection is conservative (ops/sketch.py rules:
+    #   seed-gate parity, margin-analyzed noise gate, provable band-
+    #   overlap geometry); output bytes are identical on/off (pinned).
+    #   On also lets the orientation walk speculate fwd+RC strand pairs
+    #   as ONE batch (prepare.PairBatch) — the hopeless arm dies in the
+    #   screen, halving the walk's sequential pair waves
+    seed_device_min_t: int = 16384     # CLI --seed-device-min-t: the
+    #   host/device seeding crossover — pairs whose template is at
+    #   least this long take the batched device k-mer seeder
+    #   (ops/seed_device.py, bit-equal to ops/seed.seed_diagonal);
+    #   shorter ones keep the host sort-join with its per-template
+    #   index cache.  0 disables device seeding entirely.  Purely a
+    #   performance routing knob — either path yields the same hint
     len_bucket_quant: int = 512        # whole-read mode: lengths padded to multiple
 
     # ---- device/mesh ----

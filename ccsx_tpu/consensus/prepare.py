@@ -129,6 +129,30 @@ class PairRequest:
     t_token: object = None
 
 
+@dataclasses.dataclass
+class PairBatch:
+    """A FIRST-ACCEPT group of PairRequests yielded as one step of the
+    walk (the fwd+RC strand speculation the prefilter enables,
+    cfg.prefilter).
+
+    Contract: the driver answers with a list aligned to ``requests``;
+    every entry up to and including the first accepted one is a real
+    (ok, MatchResult), later entries MAY be None (unevaluated).  The
+    walk reads results in order and stops at the first ok=True, so the
+    two legal evaluation strategies cannot diverge:
+
+    * lazily (drive_pairs / the per-hole spec path): evaluate in order,
+      stop at the first accept — exactly the sequential walk's cost;
+    * speculatively (PairExecutor): evaluate every arm in ONE batched
+      wave — the wrong-strand arm is hopeless at speculation lengths
+      and dies in the pre-alignment screen (ops/sketch.py) for the
+      cost of a screen row, while the walk saves a sequential
+      pair-wave round trip per doubtful pass.
+    """
+
+    requests: List[PairRequest]
+
+
 def _template_grp_gen(codes: np.ndarray, lens, offs, groups: List[LenGroup],
                       cfg: CcsConfig):
     """Template-group adjustment rejecting palindrome/adapter artifacts
@@ -196,6 +220,15 @@ def ccs_prepare_gen(codes: np.ndarray, lens, offs, cfg: CcsConfig):
     # aligns against tseq (then t2seq), so the executor can k-mer-sort
     # each template once for the whole hole (ops/seed.py cache)
     tok_f, tok_r = object(), object()
+    # fwd+RC speculation floor: only where the pre-alignment screen's
+    # noise gate has decisive margin over wrong-strand noise
+    # (ops/sketch.SPECULATE_MIN_QT) is a speculated wrong arm
+    # guaranteed-cheap; below it, speculation trades a sequential wave
+    # for a possible full extra DP
+    from ccsx_tpu.ops import sketch as sketch_mod
+
+    spec_min = (sketch_mod.SPECULATE_MIN_QT
+                if getattr(cfg, "prefilter", True) else None)
 
     segments = [Segment(template_offs, template_len, False)]
 
@@ -213,20 +246,40 @@ def ccs_prepare_gen(codes: np.ndarray, lens, offs, cfg: CcsConfig):
                 segments.append(seg)
                 continue
             qseq = codes[seg.offs:seg.offs + seg.length]
-            ok_f, rs = yield PairRequest(qseq, tseq,
-                                         cfg.strand_identity_pct,
-                                         t_token=tok_f)
+            fwd = PairRequest(qseq, tseq, cfg.strand_identity_pct,
+                              t_token=tok_f)
+            rcq = PairRequest(qseq, t2seq, cfg.strand_identity_pct,
+                              t_token=tok_r)
+            ok_r, rs_r = False, None
+            if (spec_min is not None
+                    and map_group[k] == template_grp
+                    and min(seg.length, template_len) >= spec_min):
+                # IN-GROUP passes only: a single-strand pass can accept
+                # on exactly one arm, so the loser is hopeless and the
+                # screen eats it; an out-of-group read-through carries
+                # both strands and would accept BOTH arms — speculation
+                # there burns a full extra DP the lazy order never pays.
+                # One first-accept batch instead of two sequential waves
+                res = yield PairBatch([fwd, rcq])
+                ok_f, rs = res[0]
+                if not ok_f:
+                    ok_r, rs_r = res[1]
+            else:
+                ok_f, rs = yield fwd
+                if not ok_f:
+                    ok_r, rs_r = yield rcq
+            # ONE epilogue for both evaluation paths (result precedence
+            # fwd-then-RC is fixed by the PairBatch contract, and the
+            # accept/clip/strand_adjust logic exists exactly once — so
+            # output bytes cannot depend on which branch ran; pinned by
+            # tests/test_sketch.py)
             if ok_f:
                 reverse = False
+            elif ok_r:
+                reverse, rs = True, rs_r
             else:
-                ok_r, rs = yield PairRequest(qseq, t2seq,
-                                             cfg.strand_identity_pct,
-                                             t_token=tok_r)
-                if ok_r:
-                    reverse = True
-                else:
-                    strand_adjust = True
-                    continue
+                strand_adjust = True
+                continue
             clipped = Segment(seg.offs + rs.qb, rs.qe - rs.qb, reverse)
             if len_in_group(groups[template_grp], clipped.length, tol):
                 segments.append(clipped)
@@ -239,16 +292,34 @@ def ccs_prepare_gen(codes: np.ndarray, lens, offs, cfg: CcsConfig):
 
 def drive_pairs(gen, aligner):
     """Run a PairRequest generator to completion with immediate
-    (per-pair) strand_match dispatches; returns its result."""
+    (per-pair) strand_match dispatches; returns its result.
+
+    PairBatches are evaluated LAZILY (in order, stopping at the first
+    accept) — the sequential walk's exact cost, so the per-hole spec
+    path never pays for speculation it cannot amortize."""
     from ccsx_tpu.utils import trace
+
+    def one(req):
+        with trace.span("pair_host", cat="prep",
+                        q=len(req.q), t=len(req.t)):
+            return aligner.strand_match(req.q, req.t, req.pct)
 
     try:
         req = next(gen)
         while True:
-            with trace.span("pair_host", cat="prep",
-                            q=len(req.q), t=len(req.t)):
-                r = aligner.strand_match(req.q, req.t, req.pct)
-            req = gen.send(r)
+            if isinstance(req, PairBatch):
+                res: List = []
+                accepted = False
+                for sub in req.requests:
+                    if accepted:
+                        res.append(None)   # first-accept: skip the rest
+                    else:
+                        r = one(sub)
+                        res.append(r)
+                        accepted = bool(r[0])
+                req = gen.send(res)
+            else:
+                req = gen.send(one(req))
     except StopIteration as e:
         return e.value
 

@@ -11,24 +11,66 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import sys
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "libccsx_io.so")
+_LOG = os.path.join(_DIR, "build.log")
 _lock = threading.Lock()
 _lib = None
 _tried = False
+_build_error: "str | None" = None
+
+
+def _note_failure(summary: str, output: str) -> None:
+    """A failed/stale auto-rebuild used to be SILENT (the native path
+    just disappeared and ingest got mysteriously slow): persist the
+    compiler output, print one loud line with the path, and remember
+    the summary for Metrics (booked as native_build_error in every
+    metrics event)."""
+    global _build_error
+    log_hint = ""
+    if output:
+        try:
+            with open(_LOG, "w", encoding="utf-8") as f:
+                f.write(output)
+            log_hint = f"; compiler log: {_LOG}"
+        except OSError:
+            pass
+    _build_error = summary
+    print(f"[ccsx-tpu] WARNING: native IO rebuild FAILED — falling back "
+          f"to the pure-Python parsers (same bytes, slower ingest): "
+          f"{summary}{log_hint}", file=sys.stderr)
 
 
 def _build() -> bool:
     try:
-        subprocess.run(
+        r = subprocess.run(
             ["make", "-s", "-C", _DIR],
-            check=True, capture_output=True, timeout=120,
+            check=False, capture_output=True, timeout=120, text=True,
         )
-        return os.path.exists(_SO)
-    except (OSError, subprocess.SubprocessError):
+    except (OSError, subprocess.SubprocessError) as e:
+        _note_failure(f"{type(e).__name__}: {e}", "")
         return False
+    if r.returncode != 0:
+        err = (r.stderr or r.stdout or "").strip()
+        first = next((ln for ln in err.splitlines() if ln.strip()),
+                     f"make rc {r.returncode}")
+        _note_failure(first[:200], (r.stdout or "") + (r.stderr or ""))
+        return False
+    if not os.path.exists(_SO):
+        _note_failure("make succeeded but libccsx_io.so is missing", "")
+        return False
+    return True
+
+
+def build_error() -> "str | None":
+    """One-line summary of a failed native auto-rebuild this process
+    observed (None when the native path loaded or was never needed).
+    Read by Metrics.snapshot() so every metrics event carries the
+    degradation."""
+    return _build_error
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -144,7 +186,11 @@ def lib():
                 return None
         try:
             _lib = _bind(ctypes.CDLL(_SO))
-        except OSError:
+        except OSError as e:
+            # a built .so that will not load (e.g. a leftover TSAN/ASAN
+            # instrumented build, static-TLS failures) is the same
+            # silent degradation as a failed compile — say so
+            _note_failure(f"libccsx_io.so failed to load: {e}", "")
             _lib = None
     return _lib
 

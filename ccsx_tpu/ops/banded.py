@@ -83,6 +83,48 @@ def _pad_prev(row, maxshift):
     )
 
 
+def _line_interp(ip, span, denom):
+    """Exact ``floor(ip * span / denom)`` in pure int32 ops.
+
+    The naive int32 product overflows once ``row * line_span`` crosses
+    2^31 — i.e. on EVERY near-square pair past ~46341 bases (2^31 =
+    46341^2), which silently froze the band offset mid-template and
+    truncated every >=47kb pair alignment to its first ~2^31/tlen rows
+    (the pre-r11 ultra-long bug: a 100kb identical pair "aligned" 21537
+    bases).  jnp.int64 is not an option (jax_enable_x64 is off, the
+    cast silently stays int32), so the 40-bit product is built from
+    8-bit limbs of |ip| with an interleaved division (after reducing
+    ``span`` modulo ``denom``) that keeps every intermediate below
+    2^31: exact while ``|ip| * denom`` fits in 2^39 — near-square
+    pairs up to ~740kb a side, far beyond any ZMW.
+    Bit-equal to the old expression wherever the old one did not
+    overflow (pinned by tests), so pre-r11 outputs are unchanged.
+
+    ``span`` and ``denom`` must be >= 0 and >= 1 respectively (line
+    ends are ordered); ``ip`` may be negative (rows before the line
+    start), handled with exact floor semantics.  The RESULT must also
+    fit int32 — guaranteed for every real line (seed hints are slope-1
+    with span == denom; default corner lines have span/denom ==
+    tlen/qlen), where |result| <= ~|ip| * slope stays near sequence
+    scale.
+    """
+    # span = slope*denom + s2 with s2 < denom; the slope term
+    # multiplies out exactly (ip*slope is result-scale), leaving a
+    # sub-denom remainder product for the limb path
+    slope = span // denom
+    s2 = span - slope * denom
+    aa = jnp.abs(ip)
+    hi = (aa >> 8) * s2              # < (|ip|/256) * denom  < 2^31
+    lo = (aa & 255) * s2             # <= 255 * denom        < 2^31
+    q1 = hi // denom
+    num = (hi - q1 * denom) * 256 + lo   # r1*256 + lo < 2^31
+    q2 = num // denom
+    mag = q1 * 256 + q2              # == floor(|ip| * s2 / denom)
+    rem = num - q2 * denom
+    return ip * slope + jnp.where(ip >= 0, mag,
+                                  -(mag + jnp.where(rem > 0, 1, 0)))
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("mode", "params", "band", "maxshift", "with_moves",
@@ -200,8 +242,10 @@ def banded_align(
         i, qi = xs  # i in 1..Qmax; qi = q[i-1]
         H_prev, E_prev, off_prev = carry["H"], carry["E"], carry["off"]
 
-        # --- band offset for this row (nominal line, monotone, coverage-safe) ---
-        nom_j = lj0 + ((i - li0) * (lj1 - lj0)) // jnp.maximum(li1 - li0, 1)
+        # --- band offset for this row (nominal line, monotone, coverage-safe;
+        # --- overflow-exact interpolation: see _line_interp) ---
+        nom_j = lj0 + _line_interp(i - li0, lj1 - lj0,
+                                   jnp.maximum(li1 - li0, 1))
         desired = nom_j - B // 2
         if mode == "local":
             lo = jnp.int32(0)

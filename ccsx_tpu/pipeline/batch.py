@@ -972,11 +972,46 @@ class PairExecutor:
     bucket, and filled in ONE batched local-mode banded DP per group —
     the same shape-bucketing discipline as the consensus rounds.
 
+    The pre-alignment plane (ISSUE 11, ROADMAP item 4) adds a filter
+    and a device seeding stage in front of the DP, both off by knob and
+    byte-invariant on:
+
+    * ``prefilter`` — hopeless candidate pairs are rejected BEFORE the
+      DP by the sketch rules (seed-gate parity / noise gate /
+      band-overlap geometry — every rule only rejects pairs whose
+      strand_match acceptance would fail, see ops/sketch.py), in two
+      forms by size: pairs at or above ``screen_min_device``
+      (sketch.SPECULATE_MIN_QT) are scored by ONE batched
+      similarity-sketch dispatch per (qmax, tmax) bucket
+      (sketch.screen_step) before even seeding — the long-template
+      regime where a doomed arm's seeding sort + DP are worth a
+      dedicated wave — while smaller pairs (down to
+      sketch.SCREEN_MIN_QT, below which the rules degenerate to the
+      legacy gate) get the SAME rules applied for free from their seed
+      computation (sketch.reject_from_hit), no extra dispatch.  The
+      screen is ADVISORY: a failed screen (device + host rung both
+      down) keeps the pair alive rather than quarantining the hole.
+      Device-SEEDED pairs never pay a dedicated screen dispatch at
+      all: the seed rows are a superset of the screen triple, so the
+      rules fire post-seeding from those statistics — one dispatch
+      does both jobs.
+    * ``seed_device_min_t`` — surviving pairs whose template is at
+      least this long seed on the device (ops/seed_device.seed_step,
+      bit-equal to seed_diagonal); shorter ones keep the cached host
+      sort-join.  0 keeps everything on the host.
+
     Shares the failure-containment ladder with BatchExecutor
-    (_run_groups_recovering): an OOM on a pair bucket bisects and
-    retries, and the last resort replays each pair through
-    HostAligner.strand_match — the per-hole spec path, so results stay
-    identical.
+    (_run_groups_recovering) at all three dispatch sites (screen, seed,
+    fill): an OOM bisects and retries, and the last resort replays on
+    the host twin (screen_host / seed_diagonal /
+    HostAligner.strand_match — the per-hole spec paths, so results stay
+    identical).
+
+    PairRequest lists may also carry prepare.PairBatch entries (the
+    walk's fwd+RC speculation): the batch's arms are evaluated
+    SPECULATIVELY in the same wave — the wrong-strand arm dies in the
+    screen — and the result slot is the aligned list of (ok, rs) the
+    first-accept contract requires.
     """
 
     # bounded LRU of per-template sorted k-mer indexes (keyed by
@@ -986,7 +1021,8 @@ class PairExecutor:
     seed_cache_max = 128
 
     def __init__(self, params: AlignParams, quant: int = 512,
-                 metrics=None, warmup=None, resil=None):
+                 metrics=None, warmup=None, resil=None,
+                 prefilter: bool = True, seed_device_min_t: int = 16384):
         self.params = params
         self.quant = quant
         self.metrics = metrics
@@ -997,9 +1033,42 @@ class PairExecutor:
         self._warmup = warmup      # AOT precompiler (pipeline/warmup.py)
         self._warmed: set = set()  # inline-warm dedupe (no compiler)
         self._host_aligner = None  # built lazily, on first fallback
+        self.prefilter = bool(prefilter)
+        self.seed_device_min_t = max(0, int(seed_device_min_t))
+        # device-screen floor: below it the filter rides the seed
+        # computation instead (reject_from_hit) — an attribute so tests
+        # can drive the dispatch site at small shapes
+        from ccsx_tpu.ops import sketch as sketch_mod
+
+        self.screen_min_device = sketch_mod.SPECULATE_MIN_QT
         from collections import OrderedDict
 
         self._seed_cache: "OrderedDict" = OrderedDict()
+
+    # ---- pre-alignment plane routing rules --------------------------------
+
+    def _screens(self, pr) -> bool:
+        return (self.prefilter
+                and min(len(pr.q), len(pr.t)) >= self.screen_min_device)
+
+    def _seeds_on_device(self, pr) -> bool:
+        return (self.seed_device_min_t > 0
+                and len(pr.t) >= self.seed_device_min_t)
+
+    @staticmethod
+    def _flatten(pairs):
+        """Expand PairBatch entries into a flat request list plus the
+        (start, count, is_batch) spans to fold results back."""
+        flat: List["prep_mod.PairRequest"] = []
+        spans: List[tuple] = []
+        for pr in pairs:
+            if isinstance(pr, prep_mod.PairBatch):
+                spans.append((len(flat), len(pr.requests), True))
+                flat.extend(pr.requests)
+            else:
+                spans.append((len(flat), 1, False))
+                flat.append(pr)
+        return flat, spans
 
     def warm(self, pairs) -> None:
         """Precompile the padded pair-fill executables this pair list
@@ -1010,28 +1079,56 @@ class PairExecutor:
         (drain() to sync), inline without one.  The predicted N is an
         upper bound — a pair that fails seeding drops out of its bucket
         and can shrink N to a smaller (also canonical pow2) batch,
-        which run() then compiles as usual."""
+        which run() then compiles as usual.  Pre-alignment shapes
+        (screen + device-seed steps) warm through the same discipline
+        so a long-pair wave's first screen books no inline compile."""
+        pairs, _ = self._flatten(pairs)
         buckets: Dict[tuple, int] = defaultdict(int)
+        screens: Dict[tuple, int] = defaultdict(int)
+        seeds: Dict[tuple, int] = defaultdict(int)
         for pr in pairs:
-            buckets[(bucket_len(len(pr.q), self.quant),
-                     bucket_len(len(pr.t), self.quant))] += 1
-        for (qmax, tmax), n in buckets.items():
-            N = _z_bucket(n)
-            key = ("pair_fill", qmax, tmax, N)
-            build = functools.partial(self._warm_build, qmax, tmax, N)
-            if self._warmup is not None:
-                self._warmup.submit(key, build)
-            elif key not in self._warmed:
-                self._warmed.add(key)
-                build()
+            key = (bucket_len(len(pr.q), self.quant),
+                   bucket_len(len(pr.t), self.quant))
+            buckets[key] += 1
+            if self._screens(pr) and not self._seeds_on_device(pr):
+                screens[key] += 1
+            if self._seeds_on_device(pr):
+                seeds[key] += 1
+        for kind, table in (("pair_fill", buckets),
+                            ("sketch_screen", screens),
+                            ("seed_device", seeds)):
+            for (qmax, tmax), n in table.items():
+                N = _z_bucket(n)
+                key = (kind, qmax, tmax, N)
+                build = functools.partial(self._warm_build, kind, qmax,
+                                          tmax, N)
+                if self._warmup is not None:
+                    self._warmup.submit(key, build)
+                elif key not in self._warmed:
+                    self._warmed.add(key)
+                    build()
 
-    def _warm_build(self, qmax, tmax, N) -> None:
-        step = _pair_fill_packed(self.params, qmax, tmax)
+    def _warm_build(self, kind, qmax, tmax, N) -> None:
         big = np.full((N, qmax + tmax), banded.PAD, np.uint8)
-        small = np.zeros((N, 6), np.int32)
-        with trace.device_span("warmup", group=f"pair:q{qmax}:t{tmax}",
+        if kind == "pair_fill":
+            step = _pair_fill_packed(self.params, qmax, tmax)
+            args = (big, np.zeros((N, 6), np.int32))
+            group = f"pair:q{qmax}:t{tmax}"
+        elif kind == "sketch_screen":
+            from ccsx_tpu.ops import sketch as sketch_mod
+
+            step = sketch_mod.screen_step(qmax, tmax)
+            args = (big, np.zeros((N, 2), np.int32))
+            group = f"sketch:q{qmax}:t{tmax}"
+        else:
+            from ccsx_tpu.ops import seed_device as sd_mod
+
+            step = sd_mod.seed_step(qmax, tmax)
+            args = (big, np.zeros((N, 2), np.int32))
+            group = f"seed:q{qmax}:t{tmax}"
+        with trace.device_span("warmup", group=group,
                                shape=f"N{N}", warmup=True):
-            jax.block_until_ready(step(big, small))
+            jax.block_until_ready(step(*args))
 
     def _seed_indexes(self, pairs):
         """Per-pair sorted template k-mer indexes for this batch: cache
@@ -1071,22 +1168,220 @@ class PairExecutor:
             indexes[i] = indexes[need_owner[tok]]
         return indexes
 
-    def run(self, pairs: List["prep_mod.PairRequest"]):
-        """Satisfy all pair requests; results align index-for-index as
-        (ok, MatchResult) tuples — the strand_match contract."""
+    def _pad_pair(self, pairs, idxs, key):
+        """(N, qmax+tmax) PAD-filled codes + (N, 2) int32 lengths — the
+        shared wire layout of the screen and device-seed dispatches
+        (padded tails are inert by construction: PAD >= 4 makes every
+        window touching them a bad k-mer, ops/sketch._codes_dev)."""
+        qmax, tmax = key
+        N = _z_bucket(len(idxs))
+        big = np.full((N, qmax + tmax), banded.PAD, np.uint8)
+        small = np.zeros((N, 2), np.int32)
+        for z, i in enumerate(idxs):
+            big[z, :qmax] = pad_to(pairs[i].q, qmax)
+            big[z, qmax:] = pad_to(pairs[i].t, tmax)
+            small[z, 0] = len(pairs[i].q)
+            small[z, 1] = len(pairs[i].t)
+        return big, small, N
+
+    def _screen_wave(self, pairs, idxs, results) -> int:
+        """The prefilter dispatch site: one batched sketch screen per
+        (qmax, tmax) bucket over ``idxs``; rejected pairs get their
+        final (False, empty MatchResult) — the same payload the walk
+        discards for any failed pair — and the count is returned.
+        Screen failures are ADVISORY (pair stays alive): the filter is
+        an optimization, never a correctness gate."""
+        from ccsx_tpu.ops import sketch as sketch_mod
+
+        triples: List = [None] * len(pairs)
+        groups: Dict[tuple, List[int]] = defaultdict(list)
+        for i in idxs:
+            groups[(bucket_len(len(pairs[i].q), self.quant),
+                    bucket_len(len(pairs[i].t), self.quant))].append(i)
+
+        def dispatch(gidxs, key):
+            qmax, tmax = key
+            big, small, N = self._pad_pair(pairs, gidxs, key)
+            faultinject.fire("device_oom")
+            if self._warmup is not None:
+                ev = self._warmup.claim(("sketch_screen", qmax, tmax, N))
+                if ev is not None:
+                    ev.wait()
+            step = sketch_mod.screen_step(qmax, tmax)
+            with trace.device_span(
+                    "sketch_screen", group=f"sketch:q{qmax}:t{tmax}",
+                    shape=f"N{N}", n=len(gidxs)) as sp:
+                faultinject.fire("stall")
+                faultinject.fire("device_hang")
+                return sp.force(step(big, small))
+
+        def finish(gidxs, key, out):
+            out = np.asarray(out)
+            for z, i in enumerate(gidxs):
+                triples[i] = tuple(int(v) for v in out[z])
+
+        def host_one(i):
+            return sketch_mod.screen_host(pairs[i].q, pairs[i].t)
+
+        if self.metrics is not None:
+            self.metrics.bump(device_dispatches=len(groups))
+        _run_groups_recovering(
+            groups, dispatch, finish, host_one, triples, self.metrics,
+            label=lambda k: f"sketch:q{k[0]}:t{k[1]}", resil=self._resil)
+        rejected = 0
+        for i in idxs:
+            tr = triples[i]
+            if not isinstance(tr, tuple):
+                continue   # screen failed for this pair: keep it alive
+            pr = pairs[i]
+            reason = sketch_mod.reject_reason(
+                tr[0], tr[1], tr[2], len(pr.q), len(pr.t), pr.pct,
+                self.params.band)
+            if reason:
+                results[i] = (False,
+                              MatchResult(False, 0, 0, 0, 0, 0, 0, 0))
+                rejected += 1
+        return rejected
+
+    def _seed_wave(self, pairs, idxs, hits, results) -> None:
+        """The device k-mer seeding dispatch site: one batched seed per
+        (qmax, tmax) bucket; rows fold back into ``hits`` as the same
+        SeedHit-or-None the host path produces (bit-equal,
+        ops/seed_device.py).  A pair whose seed failed on BOTH rungs
+        carries its Exception into ``results`` — the per-request
+        quarantine the pair-fill ladder already has."""
+        from ccsx_tpu.ops import seed as seed_mod
+        from ccsx_tpu.ops import seed_device as sd_mod
+
+        rows: List = [None] * len(pairs)
+        groups: Dict[tuple, List[int]] = defaultdict(list)
+        for i in idxs:
+            groups[(bucket_len(len(pairs[i].q), self.quant),
+                    bucket_len(len(pairs[i].t), self.quant))].append(i)
+
+        def dispatch(gidxs, key):
+            qmax, tmax = key
+            big, small, N = self._pad_pair(pairs, gidxs, key)
+            faultinject.fire("device_oom")
+            if self._warmup is not None:
+                ev = self._warmup.claim(("seed_device", qmax, tmax, N))
+                if ev is not None:
+                    ev.wait()
+            step = sd_mod.seed_step(qmax, tmax)
+            with trace.device_span(
+                    "seed_device", group=f"seed:q{qmax}:t{tmax}",
+                    shape=f"N{N}", n=len(gidxs)) as sp:
+                faultinject.fire("stall")
+                faultinject.fire("device_hang")
+                return sp.force(step(big, small))
+
+        def finish(gidxs, key, out):
+            out = np.asarray(out)
+            for z, i in enumerate(gidxs):
+                rows[i] = [int(v) for v in out[z]]
+
+        def host_one(i):
+            hit = seed_mod.seed_diagonal(pairs[i].q, pairs[i].t)
+            if hit is None:
+                return [0] * 8
+            return [1, hit.diag, hit.votes, *(int(v) for v in hit.line),
+                    0]
+
+        if self.metrics is not None:
+            self.metrics.bump(device_dispatches=len(groups))
+        _run_groups_recovering(
+            groups, dispatch, finish, host_one, rows, self.metrics,
+            label=lambda k: f"seed:q{k[0]}:t{k[1]}", resil=self._resil)
+        for i in idxs:
+            r = rows[i]
+            if isinstance(r, Exception):
+                results[i] = r   # quarantines the calling hole
+            elif r is not None:
+                hits[i] = sd_mod.hit_from_row(r)
+
+    def run(self, pairs):
+        """Satisfy all pair requests; results align index-for-index —
+        (ok, MatchResult) tuples for PairRequests (the strand_match
+        contract), lists of them for PairBatch entries (the
+        first-accept contract; speculative arms are all evaluated)."""
+        flat, spans = self._flatten(pairs)
+        results = self._run_flat(flat)
+        out = []
+        for start, n, is_batch in spans:
+            out.append(list(results[start:start + n]) if is_batch
+                       else results[start])
+        return out
+
+    def _run_flat(self, pairs: List["prep_mod.PairRequest"]):
         from ccsx_tpu.ops import seed as seed_mod
 
         results = [None] * len(pairs)
         groups: Dict[tuple, List[int]] = defaultdict(list)
         lines: Dict[int, np.ndarray] = {}
-        seed_idx = self._seed_indexes(pairs)
+
+        # stage 1 — the batched device screen, but ONLY for big pairs
+        # that will NOT device-seed: the seed dispatch (stage 2) is a
+        # superset of the screen (its rows carry total+votes+the median
+        # line), so a device-seeded pair gets the same rejection rules
+        # for free in stage 3 (reject_from_hit) and a dedicated screen
+        # wave would be a second dispatch computing the same hits.
+        # Smaller pairs likewise ride their (host) seed statistics.
+        from ccsx_tpu.ops import sketch as sketch_mod
+
+        screen_ids = [i for i, pr in enumerate(pairs)
+                      if self._screens(pr)
+                      and not self._seeds_on_device(pr)]
+        rejected = 0
+        if screen_ids:
+            with trace.span("prefilter", cat="prep", n=len(screen_ids)):
+                rejected = self._screen_wave(pairs, screen_ids, results)
+
+        # stage 2 — seeding for the survivors: device for long
+        # templates (>= seed_device_min_t), cached host sort-join below
+        hits: Dict[int, object] = {}
+        dev_ids = [i for i, pr in enumerate(pairs)
+                   if results[i] is None and self._seeds_on_device(pr)]
+        dev_set = set(dev_ids)
+        host_ids = [i for i, pr in enumerate(pairs)
+                    if results[i] is None and i not in dev_set]
+        sub = [pairs[i] for i in host_ids]
+        seed_idx = self._seed_indexes(sub)
+        for pos, i in enumerate(host_ids):
+            hits[i] = seed_mod.seed_diagonal(pairs[i].q, pairs[i].t,
+                                             t_index=seed_idx.get(pos))
+        if dev_ids:
+            self._seed_wave(pairs, dev_ids, hits, results)
+        if self.metrics is not None and (dev_ids or host_ids):
+            self.metrics.bump(pairs_seeded_device=len(dev_ids),
+                              pairs_seeded_host=len(host_ids))
+
+        # stage 3 — the zero-dispatch filter rung, then the banded fill
+        # for every surviving pair.  Every prefilter-eligible pair that
+        # did not go through the stage-1 screen — host-seeded pairs
+        # above SCREEN_MIN_QT and ALL device-seeded pairs — gets rules
+        # (b)/(c) from its seed statistics here (reject_from_hit, at
+        # the true median line); stage-1-screened pairs were already
+        # filtered pre-seeding and just pass through.
+        screen_set = set(screen_ids)
+        screened = len(screen_ids)
         for i, pr in enumerate(pairs):
-            hit = seed_mod.seed_diagonal(pr.q, pr.t,
-                                         t_index=seed_idx.get(i))
+            if results[i] is not None:
+                continue
+            hit = hits.get(i)
             if hit is None:
                 # no shared 13-mers: unalignable at >=60% identity
                 results[i] = (False, MatchResult(False, 0, 0, 0, 0, 0, 0, 0))
                 continue
+            if (self.prefilter and i not in screen_set
+                    and min(len(pr.q), len(pr.t))
+                    >= sketch_mod.SCREEN_MIN_QT):
+                screened += 1
+                if sketch_mod.reject_from_hit(hit, len(pr.q), len(pr.t),
+                                              pr.pct, self.params.band):
+                    results[i] = (False, MatchResult(False, 0, 0, 0, 0,
+                                                     0, 0, 0))
+                    rejected += 1
+                    continue
             if abs(hit.diag) > self.params.band // 4:
                 lines[i] = np.asarray(hit.line, np.int32)
             else:
@@ -1104,9 +1399,14 @@ class PairExecutor:
                 real += self.params.band * int(
                     sum(len(pairs[i].q) for i in idxs))
             # bump(): the pair gate's pump thread runs this concurrently
-            # with the driver's refine sweeps (pipeline/prep_pool.py)
+            # with the driver's refine sweeps (pipeline/prep_pool.py).
+            # pairs_screened counts every pair the filter EXAMINED
+            # (device screen + the zero-dispatch seed-statistics rung);
+            # pairs_prefiltered the ones it rejected pre-DP.
             self.metrics.bump(pair_alignments=len(lines),
                               device_dispatches=len(groups),
+                              pairs_screened=screened,
+                              pairs_prefiltered=rejected,
                               dp_cells_padded=padded,
                               dp_cells_real=real)
 
@@ -2044,7 +2344,13 @@ def _advance_hole(hole: _Hole, rr) -> None:
 def _feed_hole(hole: _Hole, result) -> None:
     """Route an executor result back into a hole's generator — unless it
     is an Exception (an executor's last-resort host replay failed for
-    this one request), which quarantines the hole, not the run."""
+    this one request), which quarantines the hole, not the run.  A
+    PairBatch result (a list) quarantines on its first embedded
+    Exception the same way."""
+    if isinstance(result, list):
+        exc = next((r for r in result if isinstance(r, Exception)), None)
+        if exc is not None:
+            result = exc
     if isinstance(result, Exception):
         hole.done, hole.req, hole.err = True, None, result
         try:
@@ -2126,7 +2432,9 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
                              resil=resil)
     pair_executor = PairExecutor(cfg.align, quant=cfg.len_bucket_quant,
                                  metrics=metrics, warmup=warm,
-                                 resil=resil)
+                                 resil=resil,
+                                 prefilter=cfg.prefilter,
+                                 seed_device_min_t=cfg.seed_device_min_t)
 
     def warm_hole(h) -> None:
         if warm is not None and isinstance(h.req, RefineRequest):
@@ -2329,9 +2637,12 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
             # kind: prep pair alignments (strand_match walks) and
             # consensus rounds each batch across holes
             pair_holes = [h for h in active
-                          if isinstance(h.req, prep_mod.PairRequest)]
+                          if isinstance(h.req, (prep_mod.PairRequest,
+                                                prep_mod.PairBatch))]
             round_holes = [h for h in active
-                           if not isinstance(h.req, prep_mod.PairRequest)]
+                           if not isinstance(h.req,
+                                             (prep_mod.PairRequest,
+                                              prep_mod.PairBatch))]
             if pair_holes:
                 # inline-mode only in practice (the pool finishes the
                 # walk before handing a hole over); this sweep blocks

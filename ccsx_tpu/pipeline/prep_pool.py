@@ -255,10 +255,18 @@ class PrepPool:
                 faultinject.fire("compute")
                 h.gen = full_gen_for_zmw(h.zmw, self._cfg)
                 req = next(h.gen)
-                while isinstance(req, prep_mod.PairRequest):
+                while isinstance(req, (prep_mod.PairRequest,
+                                       prep_mod.PairBatch)):
                     w0 = time.perf_counter()
                     res = self._gate.align(req)
                     wait_s += time.perf_counter() - w0
+                    if isinstance(res, list):
+                        # PairBatch result: its first embedded failure
+                        # quarantines, like a scalar one below
+                        exc = next((r for r in res
+                                    if isinstance(r, Exception)), None)
+                        if exc is not None:
+                            res = exc
                     if isinstance(res, Exception):
                         # the executor's last-resort host replay failed
                         # for this pair: quarantine this hole (same as

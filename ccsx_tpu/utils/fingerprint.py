@@ -54,6 +54,13 @@ _NON_SEMANTIC = frozenset({
     # records are accepted, so resuming across a change would splice
     # sections read under different acceptance rules.
     "salvage",
+    # pre-alignment plane (ops/sketch.py + ops/seed_device.py): the
+    # prefilter only rejects pairs whose strand_match acceptance
+    # would fail (the walk discards a failed pair's payload), and the
+    # device seeder is bit-equal to the host one — neither can change
+    # output bytes (pinned by the scale-config md5 across prefilter
+    # on/off and both crossover settings)
+    "prefilter", "seed_device_min_t",
 })
 
 

@@ -132,6 +132,17 @@ class Metrics:
     corrupt_reasons: dict = dataclasses.field(default_factory=dict)
     windows: int = 0
     pair_alignments: int = 0   # batched prep strand_match pairs
+    # pre-alignment plane (ISSUE 11, ops/sketch.py + ops/seed_device.py):
+    # candidate pairs scored by the batched device screen, pairs it
+    # rejected BEFORE seeding/DP (prefilter_share in snapshot() is
+    # rejected/screened — the long-template regime's removed waste),
+    # and the device-vs-host k-mer seeding split (--seed-device-min-t
+    # crossover).  All bumped by PairExecutor, possibly from the pair
+    # gate's pump thread.
+    pairs_screened: int = 0
+    pairs_prefiltered: int = 0
+    pairs_seeded_device: int = 0
+    pairs_seeded_host: int = 0
     device_dispatches: int = 0
     refine_overflows: int = 0  # fused windows replayed on host (rare)
     # fault-tolerance ladder counters (pipeline/batch.py recovery):
@@ -416,6 +427,13 @@ class Metrics:
             "stalls": self.stalls,
             "windows": self.windows,
             "pair_alignments": self.pair_alignments,
+            "pairs_screened": self.pairs_screened,
+            "pairs_prefiltered": self.pairs_prefiltered,
+            "prefilter_share": round(self.pairs_prefiltered
+                                     / self.pairs_screened, 4)
+                               if self.pairs_screened else None,
+            "pairs_seeded_device": self.pairs_seeded_device,
+            "pairs_seeded_host": self.pairs_seeded_host,
             "device_dispatches": self.device_dispatches,
             "refine_overflows": self.refine_overflows,
             "oom_resplits": self.oom_resplits,
@@ -499,6 +517,20 @@ class Metrics:
             snap["compile_share"] = round(comp / self.elapsed, 4)
         if self.degraded:
             snap["degraded"] = self.degraded
+        # degraded-relevant detail: a FAILED native .so auto-rebuild
+        # silently disables the C++ IO path (pure-Python fallback, same
+        # bytes, much slower ingest) — surface it in every event so a
+        # mysteriously slow run is diagnosable from its metrics alone.
+        # Read lazily from the loader (no jax, no rebuild attempt — the
+        # loader caches its one try).
+        try:
+            from ccsx_tpu import native as native_mod
+
+            err = native_mod.build_error()
+        except Exception:
+            err = None
+        if err:
+            snap["native_build_error"] = err
         return snap
 
     def emit(self, event: str, **kw) -> None:

@@ -61,6 +61,10 @@ PROM_COUNTERS = (
     "holes_in", "holes_out", "holes_failed", "holes_filtered",
     "holes_corrupt", "stalls",
     "windows", "pair_alignments", "device_dispatches", "refine_overflows",
+    # pre-alignment plane (ops/sketch.py + ops/seed_device.py): screen
+    # coverage/rejections and the device-vs-host seeding split
+    "pairs_screened", "pairs_prefiltered",
+    "pairs_seeded_device", "pairs_seeded_host",
     "oom_resplits", "host_fallbacks", "compile_fallbacks",
     # resilient execution (pipeline/resilience.py): abandoned
     # dispatches + circuit-breaker trips and half-open probes
@@ -71,7 +75,7 @@ PROM_COUNTERS = (
 # snapshot keys exported as gauges (ratios, seconds, rates)
 PROM_GAUGES = (
     "dp_occupancy", "dp_round_occupancy", "dp_length_fill",
-    "dp_pass_fill", "dp_z_fill", "dp_row_fill",
+    "dp_pass_fill", "dp_z_fill", "dp_row_fill", "prefilter_share",
     "packed_holes_per_dispatch", "fused_slot_fill",
     "ingest_s", "prep_s", "compute_s", "write_s", "elapsed_s",
     "zmws_per_sec", "compile_s", "compile_share",
@@ -83,7 +87,10 @@ PROM_GAUGES = (
 # snapshot keys with dedicated (non-scalar) renderings
 PROM_STRUCTURED = ("groups", "groups_forced", "degraded", "progress",
                    "filtered_reasons", "corrupt_reasons",
-                   "breaker_state", "breaker_strike_log")
+                   "breaker_state", "breaker_strike_log",
+                   # failed native .so auto-rebuild (string detail;
+                   # rendered as a 0/1 gauge like degraded)
+                   "native_build_error")
 # per-group table fields exported as ccsx_group_<field>{group="..."}
 GROUP_FIELDS = ("compiles", "compile_s", "execute_s", "dispatches",
                 "dp_cells", "dp_cells_per_sec")
@@ -162,6 +169,8 @@ def render_prometheus(snap: dict, gauges: Optional[dict] = None) -> str:
     if "groups_forced" in snap:
         sample("groups_forced", int(bool(snap["groups_forced"])), "gauge")
     sample("degraded", int(bool(snap.get("degraded"))), "gauge")
+    sample("native_build_error",
+           int(bool(snap.get("native_build_error"))), "gauge")
     # circuit-breaker state as a labeled gauge: exactly one sample, its
     # label naming the current state (closed / open / half-open) — the
     # alerting-friendly rendering (breaker_strike_log stays JSON-only:

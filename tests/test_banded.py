@@ -208,3 +208,41 @@ def test_with_stats_false_same_moves(rng):
     np.testing.assert_array_equal(np.asarray(r1.score), np.asarray(r2.score))
     # stats channels are intentionally absent: reported as zero
     assert int(np.asarray(r2.aln).max()) == 0
+
+
+def test_line_interp_exact_incl_overflow_range():
+    """The band's nominal-line interpolation must be exact past the
+    int32 product cliff: the pre-r11 expression `(i-li0)*(lj1-lj0)//D`
+    wrapped once row*span crossed 2^31 (every near-square pair past
+    ~46341 bases), freezing the band offset mid-template and silently
+    truncating ultra-long pair alignments.  _line_interp is pinned
+    against Python big-int floor division across the realistic line
+    space (slope-sane: |result| fits int32), overflow region included,
+    negative rows (before a hinted line start) included."""
+    rng = np.random.default_rng(11)
+    for _ in range(3000):
+        denom = int(rng.integers(1, 300001))
+        # slope <= 8: covers corner lines (tlen/qlen) and slope-1 hints
+        span = min(int(denom * rng.uniform(0, 8)), 2**21)
+        ip = int(rng.integers(-300000, 300001))
+        got = int(banded._line_interp(
+            np.int32(ip), np.int32(span), np.int32(denom)))
+        assert got == (ip * span) // denom, (ip, span, denom)
+
+
+@pytest.mark.parametrize("L", [100352])
+def test_local_full_span_past_int32_cliff(L):
+    """A (noise-free) identical pair PAST the 2^31 interpolation cliff
+    must align end-to-end: before the r11 fix a 100kb identical pair
+    'aligned' exactly floor(2^31/tlen)+band-ish rows (qe 21537) because
+    the frozen band offset lost the diagonal.  One jitted call at the
+    real bucketed shape; also guards the off-tracker's monotone clip
+    path at scale."""
+    from ccsx_tpu.consensus.star import pad_to
+
+    t = np.random.default_rng(5).integers(0, 4, L).astype(np.uint8)
+    r = banded.banded_align(pad_to(t, L), np.int32(L), pad_to(t, L),
+                            np.int32(L), mode="local",
+                            params=AlignParams())
+    assert int(r.qe) == L and int(r.score) == 2 * L
+    assert int(r.mat) == L

@@ -104,6 +104,7 @@ def test_cli_batched_equals_per_hole(tmp_path, rng):
     assert o_ref.read_text().count(">") == 4
 
 
+@pytest.mark.slow  # ~20s: projector A/B; per-hole equality tests stay tier-1 (r11 audit)
 def test_cli_batched_scan_projector_equals_walk(tmp_path, rng, monkeypatch):
     """CCSX_PROJECTOR=scan (the TPU-default row-scan traceback,
     ops/traceback.make_projector_scan) through the FULL fused batched

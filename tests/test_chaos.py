@@ -42,21 +42,18 @@ def test_chaos_fast_slice(tmp_path):
     input_corrupt under --salvage) on a 3-hole corpus, every one
     holding its oracle.  Failures print the full per-trial detail
     (seeded: any red trial is replayable with the same seed)."""
-    summary = chaos.run_trials(seed=0, trials=3, holes=3,
+    summary = chaos.run_trials(seed=0, trials=2, holes=3,
                                include_kills=False,
                                include_shepherd=False,
                                tmp=str(tmp_path))
-    assert summary["n_trials"] == 5
+    assert summary["n_trials"] == 4
     kinds = {t["kind"] for t in summary["trials"]}
     assert "disk_full_resume" in kinds and "input_corrupt" in kinds
     assert summary["ok"], summary["trials"]
-    # the seeded schedule is deterministic: same seed, same specs
-    again = chaos.run_trials(seed=0, trials=3, holes=3,
-                             include_kills=False,
-                             include_shepherd=False,
-                             tmp=str(tmp_path))
-    assert [t["spec"] for t in again["trials"]] == \
-        [t["spec"] for t in summary["trials"]]
+    # replayability is the seeded np.random.default_rng stream (version-
+    # stable): same seed, same schedule — the slow-tier soak runs the
+    # schedule twice to assert it; re-executing every trial here doubled
+    # the tier-1 slice's wall for no new coverage (r11 duration audit)
 
 
 def test_chaos_hang_trial_directly(tmp_path):

@@ -42,9 +42,11 @@ def test_fuzz_fast_slice(tmp_path):
     summary = corrupt.run_sweep(seed=0, mutants=2, tmp=str(tmp_path))
     assert summary["n_trials"] == 3 * (2 + 1)
     assert summary["ok"], summary["failed"]
-    # determinism: the same seed draws the same mutation schedule
-    again = corrupt.run_sweep(seed=0, mutants=2, tmp=str(tmp_path))
-    assert [r["mutation"] for r in again["failed"]] == []
+    # replayability is the seeded np.random.default_rng stream (version-
+    # stable); the old second full sweep here re-executed every mutant
+    # to assert it — pure duplicate wall in the tier-1 slice (r11
+    # duration audit), and the slow-tier 50-mutant sweep keeps the
+    # deeper coverage
     assert summary["elapsed_s"] >= 0
 
 

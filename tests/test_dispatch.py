@@ -363,6 +363,37 @@ def test_bench_vs_prev_group_compile_gate():
     assert "regressed" not in ok
 
 
+def test_bench_vs_prev_quality_gate():
+    """The quality leg of vs_prev (ROADMAP item 5 tail): a >20% drop in
+    gate_biased Q20 yield vs the prior bench line flags `regressed`
+    exactly like a perf drop; in-tolerance drift stays quiet; and the
+    current line always embeds the newest quality artifact's yields."""
+    bench = _bench_mod()
+    line = {"backend": "cpu"}
+    vp, reg = {}, []
+    bench.compare_quality(line, {"quality":
+                                 {"gate_biased_q20_yield": 0.30}},
+                          vp, reg)
+    # the repo's committed artifact (0.14) is a >20% drop from 0.30
+    assert line["quality"]["artifact"].startswith("quality_r")
+    assert vp["gate_biased_q20_yield"]["prev"] == 0.30
+    assert reg and "q20_yield" in reg[0]
+    # drift within tolerance: quiet
+    vp2, reg2 = {}, []
+    cur_y = line["quality"]["gate_biased_q20_yield"]
+    bench.compare_quality({"backend": "cpu"},
+                          {"quality":
+                           {"gate_biased_q20_yield": cur_y * 1.1}},
+                          vp2, reg2)
+    assert reg2 == []
+    # and the full compare_with_prev path carries it end to end
+    cur = {"backend": "cpu", "dp_cells_per_sec": 100, "e2e": []}
+    prev = {"backend": "cpu", "dp_cells_per_sec": 100, "e2e": [],
+            "quality": {"gate_biased_q20_yield": 0.30}}
+    bench.compare_with_prev(cur, prev, "BENCH_rX.json")
+    assert any("q20_yield" in r for r in cur.get("regressed", []))
+
+
 def test_bench_device_attempt_report(tmp_path):
     """A degraded CPU-fallback artifact must carry the failed device
     attempt's stall diagnostics: the watchdog's last in-flight shape

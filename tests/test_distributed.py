@@ -13,6 +13,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 from ccsx_tpu import cli
 from ccsx_tpu.io import fastx
@@ -52,6 +53,7 @@ def test_sharded_run_merge_equals_single_host(tmp_path, rng):
     assert out.read_text() == ref.read_text()
 
 
+@pytest.mark.slow  # ~12s: FASTQ twin of the BAM merge test above (r11 audit)
 def test_sharded_fastq_merge_equals_single_host(tmp_path, rng):
     """--fastq shards (4-line records) must merge byte-identically to
     the single-process FASTQ output."""

@@ -46,6 +46,7 @@ def test_parity_missing_binary_refused(tmp_path):
         parity.run_parity(str(tmp_path / "nope"), 2, [1])
 
 
+@pytest.mark.slow  # ~20s: stub-binary harness mechanics (r11 duration audit)
 def test_parity_harness_runs_against_stub(stub_bin, tmp_path):
     summary = parity.run_parity(stub_bin, 2, [1], seed=0)
     assert summary["ccsx_bin"] == stub_bin

@@ -114,6 +114,7 @@ def test_pool_on_off_byte_identical(corpus, tmp_path):
     assert out.read_bytes() == ref.read_bytes()
 
 
+@pytest.mark.slow  # ~20s: admission-window A/B; pool identity stays tier-1 (r11 audit)
 def test_adaptive_vs_pinned_window_identical(corpus, tmp_path):
     """An explicit --inflight pins the old fixed window; bytes match
     the adaptive default exactly (scheduling-only change)."""
@@ -228,6 +229,7 @@ def test_kill_and_resume_with_prep_threads(corpus, tmp_path):
     assert json.loads(jp.read_text())["holes_done"] == 6
 
 
+@pytest.mark.slow  # ~17s: resume-budget livelock guard (r11 duration audit)
 def test_resumed_stretch_does_not_stall_pool(corpus, tmp_path):
     """A resume whose already-done stretch exceeds the 4x-inflight
     ingest budget must keep retiring resumed holes while the driver

@@ -349,6 +349,7 @@ def test_drain_guard_noop_off_main_thread():
 # ---------- disk-full hardening ----------
 
 
+@pytest.mark.slow  # ~5s: chaos fast slice keeps a disk_full_resume trial (r11 audit)
 def test_enospc_clean_rc1_then_resume(corpus, tmp_path, monkeypatch,
                                       capsys):
     """Injected ENOSPC at the writer: clean rc 1 (no traceback), the

@@ -336,6 +336,8 @@ def _populated_snapshot():
     m = Metrics()
     for f in ("holes_in", "holes_out", "holes_failed", "holes_filtered",
               "stalls", "windows", "pair_alignments",
+              "pairs_screened", "pairs_prefiltered",
+              "pairs_seeded_device", "pairs_seeded_host",
               "device_dispatches", "refine_overflows", "oom_resplits",
               "host_fallbacks", "compile_fallbacks", "dp_cells_real",
               "dp_cells_padded", "dp_round_cells_real",
