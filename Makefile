@@ -4,7 +4,7 @@
 PY ?= python
 PYTEST_FLAGS = -q -p no:cacheprovider -p no:xdist -p no:randomly
 
-.PHONY: chaos chaos-soak fuzz fuzz-sweep tier1 native long-molecule
+.PHONY: chaos chaos-soak fleet-chaos fuzz fuzz-sweep tier1 native long-molecule
 
 # the long-template (ultra-long-read) A/B: prefilter + device seeding
 # vs the legacy host path, interleaved arms, bytes asserted identical
@@ -32,6 +32,16 @@ fuzz:
 fuzz-sweep:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_corrupt_fuzz.py $(PYTEST_FLAGS)
 	JAX_PLATFORMS=cpu $(PY) benchmarks/corrupt.py --seed 0 --mutants 50
+
+# elastic fleet churn: the deterministic tier-1 slice (tests/
+# test_fleet.py fast tests: lease crash-consistency + SIGKILL/drain/
+# join byte-identity) then the seeded soak mixing rank SIGKILL,
+# mid-run --join, SIGTERM drain, and a straggler against the
+# byte-identity oracle (also directly:
+# python benchmarks/fleet.py --seed N [--scale64])
+fleet-chaos:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet.py $(PYTEST_FLAGS)
+	JAX_PLATFORMS=cpu $(PY) benchmarks/fleet.py --seed 0 --holes 6
 
 # the full randomized soak (also available directly:
 # python benchmarks/chaos.py --seed N --trials T)

@@ -135,6 +135,73 @@ def compare_quality(line, prev, vp, regressed):
             "regression, realistic-error regime)")
 
 
+def latest_fleet_artifacts(root=_HERE, n=2):
+    """The ``n`` highest-numbered usable benchmarks/fleet_r*.json
+    artifacts (the elastic-fleet churn soak, benchmarks/fleet.py),
+    newest first, as (name, summary) pairs.  Usable = carries the
+    derived scale-out ratio; the summary also keeps the one-bit
+    byte-identity verdict and the killed-at-halfway overhead."""
+    import glob
+    import re
+
+    cands = []
+    for p in glob.glob(os.path.join(root, "benchmarks",
+                                    "fleet_r*.json")):
+        m = re.search(r"fleet_r(\d+)\.json$", p)
+        if m:
+            cands.append((int(m.group(1)), p))
+    out = []
+    for _, p in sorted(cands, reverse=True):
+        try:
+            with open(p) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            continue
+        derived = d.get("derived") or {}
+        if derived.get("scaleout_k4") is None:
+            continue
+        out.append((os.path.basename(p),
+                    {"scaleout_k4": derived["scaleout_k4"],
+                     "kill_overhead_x": derived.get("kill_overhead_x"),
+                     "ok": d.get("ok")}))
+        if len(out) >= n:
+            break
+    return out
+
+
+def compare_fleet(line, prev, vp, regressed):
+    """The fleet leg of the vs_prev gate: scale-out efficiency (K=1
+    wall / K=4 wall) from the newest fleet_r*.json artifact vs the
+    prior bench line's (or the second-newest artifact).  A >20%
+    relative drop in scale-out — or ANY non-byte-identical trial in
+    the newest soak — trips ``regressed`` exactly like a perf drop:
+    elastic scheduling that stops scaling (or stops being exact) is a
+    regression of the whole plane.  Wall ratios of a CPU-hosted soak
+    compare fine across rounds (same harness, same corpus), so no
+    backend gating applies."""
+    arts = latest_fleet_artifacts()
+    if arts:
+        name, summary = arts[0]
+        line["fleet"] = {"artifact": name, **summary}
+        if summary.get("ok") is False:
+            regressed.append(
+                f"fleet soak {name} has non-byte-identical trials "
+                "(fleet churn changed the output bytes)")
+    cur = (line.get("fleet") or {}).get("scaleout_k4")
+    prev_s = ((prev or {}).get("fleet") or {}).get("scaleout_k4")
+    prev_src = "prev bench line"
+    if prev_s is None and len(arts) > 1:
+        prev_src, prev_s = arts[1][0], arts[1][1]["scaleout_k4"]
+    if cur is None or prev_s is None:
+        return
+    vp["fleet_scaleout_k4"] = {"prev": prev_s, "cur": cur,
+                               "prev_source": prev_src}
+    if prev_s > 0 and cur < prev_s * REGRESSION_DROP:
+        regressed.append(
+            f"fleet scaleout_k4 {prev_s}->{cur} (elastic scheduling "
+            "regression)")
+
+
 def compare_with_prev(line, prev, artifact):
     """Mutates ``line``: adds "vs_prev" (ratios vs the prior artifact
     for dp_cells_per_sec and per-config e2e zmws_per_sec) and, on a
@@ -250,8 +317,10 @@ def compare_with_prev(line, prev, artifact):
             vp["zmws_per_sec_configs"] = ratios
             if g < REGRESSION_DROP:
                 regressed.append(f"e2e zmws_per_sec x{g:.2f}")
-    # the quality leg rides every comparison (backend-independent)
+    # the quality and fleet legs ride every comparison (both are
+    # backend-independent properties of committed artifacts)
     compare_quality(line, prev, vp, regressed)
+    compare_fleet(line, prev, vp, regressed)
     line["vs_prev"] = vp
     if regressed:
         line["regressed"] = regressed
@@ -594,13 +663,14 @@ def _inner_main():
               "note": "no prior BENCH_r*.json artifact; vs_baseline "
                       "reports the native yardstick"}
         regressed = []
-        # the quality gate still applies: two quality artifacts can
+        # the quality and fleet gates still apply: two artifacts can
         # exist before any bench artifact does
         compare_quality(line, None, vp, regressed)
+        compare_fleet(line, None, vp, regressed)
         line["vs_prev"] = vp
         if regressed:
             line["regressed"] = regressed
-            print("[bench] " + "!" * 20 + " QUALITY REGRESSION: "
+            print("[bench] " + "!" * 20 + " ARTIFACT REGRESSION: "
                   + "; ".join(regressed) + " " + "!" * 20,
                   file=sys.stderr)
 

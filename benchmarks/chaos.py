@@ -40,6 +40,11 @@ and this CLI:
 
     python benchmarks/chaos.py --seed 0 --trials 12 --holes 6 \
         --json benchmarks/chaos_rNN.json
+
+Fleet-membership churn (rank SIGKILL under the ELASTIC scheduler,
+mid-run --join, SIGTERM drain, stragglers) is the fleet soak's domain
+— benchmarks/fleet.py reuses this harness's corpus builder, reference
+runner, and byte-identity oracle (`make fleet-chaos`).
 """
 
 from __future__ import annotations

@@ -1,0 +1,245 @@
+"""Fleet chaos soak: elastic leased-range scheduling under fleet churn.
+
+The elastic fleet plane's claim (pipeline/fleet.py + supervisor
+``fleet_run``) extends the chaos harness's: fleet membership churn —
+a rank SIGKILLed mid-run, a rank SIGTERM-draining out, a rank joining
+mid-run (`shepherd --join`), a straggler rank — changes WHICH worker
+computes each leased range, never the merged bytes.  Every trial here
+runs a K-worker leased-range fleet end-to-end and asserts byte-identity
+against the unsharded fault-free reference.
+
+Two extra numbers ride in the summary:
+
+* **scale-out efficiency** — fault-free K-worker wall vs 1-worker wall
+  (``bench.py`` gates this ``vs_prev`` across rounds);
+* **killed-at-halfway overhead** — wall of a K=4 run with one worker
+  SIGKILLed mid-run (zero restart budget: the survivors absorb its
+  ranges via reap-time reclaim) over the fault-free K=4 wall.  The
+  acceptance bar is ~1.4x: rank loss costs about one range of
+  recompute, not 1/K of the run.
+
+The ``--scale64`` mode replays the 64-hole scale config
+(benchmarks/e2e_scale.py's corpus: rng(42), 1-5 kb lognormal-pass
+BGZF BAM + hole index, ``--batch on --inflight 64``) and checks the
+pinned unsharded md5 (``0c83700d…``, the PR7/PR8/PR11 byte-identity
+pin) before running the fleet variants against it — the acceptance
+corpus for this plane.
+
+The fast deterministic slice runs in tier-1 (tests/test_fleet.py,
+`make fleet-chaos` runs this CLI):
+
+    python benchmarks/fleet.py --seed 0 --holes 6 \
+        --json benchmarks/fleet_rNN.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from ccsx_tpu import cli                                     # noqa: E402
+from benchmarks.chaos import (                               # noqa: E402
+    _base_args, make_corpus, run_reference)
+
+# the pinned unsharded output of the 64-hole scale config (the
+# acceptance corpus): any drift here is an output-bytes regression in
+# the consensus plane, not a fleet bug — fix that first
+SCALE64_MD5 = "0c83700d0fb67e3c89169f99574a9a2d"
+SCALE64_BYTES = 188359
+
+
+def _scale64_args(in_bam: str, out: str, extra=()) -> list:
+    return ["--batch", "on", "--inflight", "64", *extra, in_bam, out]
+
+
+def make_scale64_corpus(tmp: str) -> str:
+    """EXACTLY benchmarks/e2e_scale.py's 64-hole scale config: a fresh
+    rng(42) into make_big_bam (1-5 kb templates, lognormal pass counts,
+    read-throughs every 5th hole), BGZF container + hole index."""
+    from benchmarks.e2e_scale import make_big_bam
+    from ccsx_tpu.io import bamindex
+
+    rng = np.random.default_rng(42)
+    p = os.path.join(tmp, "in64.bam")
+    make_big_bam(p, 64, rng)
+    bamindex.build_index(p)
+    return p
+
+
+def run_scale64_reference(in_bam: str, tmp: str) -> bytes:
+    ref = os.path.join(tmp, "ref64.fa")
+    rc = cli.main(_scale64_args(in_bam, ref))
+    assert rc == 0, f"fault-free scale64 reference failed rc={rc}"
+    return open(ref, "rb").read()
+
+
+def _fleet_run(in_fa: str, out: str, hosts: int, ranges: int,
+               mkargs=_base_args, scale=False, **kw):
+    from ccsx_tpu.pipeline.supervisor import fleet_run
+
+    fwd = mkargs(in_fa, out)
+    cfg = cli.config_from_args(cli.build_parser().parse_args(fwd))
+    kw.setdefault("env", dict(os.environ, CCSX_JOURNAL_FSYNC_S="0"))
+    # lease timeout must exceed the worst GIL stall a healthy worker
+    # can suffer (jit TRACING holds the GIL and starves the renewer
+    # thread; four workers cold-tracing the scale64 corpus under full
+    # CPU contention measured >60 s), or the scheduler SIGKILLs live
+    # workers — safe (the range requeues and resumes) but it pollutes
+    # the wall numbers.  Liveness on real faults does not depend on
+    # this: a reaped worker's leases free instantly (reap-time
+    # reclaim); the timeout only covers unreapable holders.
+    kw.setdefault("lease_timeout", 300.0 if scale else 10.0)
+    t0 = time.monotonic()
+    rc = fleet_run(in_fa, out, cfg, hosts, fwd, ranges=ranges,
+                   poll_s=0.1, backoff_s=0.1, **kw)
+    return rc, time.monotonic() - t0
+
+
+def _trial(kind, in_fa, tmp, ref, hosts, ranges, mkargs=_base_args,
+           scale=False, **kw):
+    out = os.path.join(tmp, f"o_{kind}.fa")
+    rc, wall = _fleet_run(in_fa, out, hosts, ranges, mkargs, scale,
+                          **kw)
+    got = open(out, "rb").read() if os.path.exists(out) else b""
+    return {"kind": kind, "hosts": hosts, "ranges": ranges, "rc": rc,
+            "wall_s": round(wall, 2), "identical": got == ref,
+            "ok": rc == 0 and got == ref}
+
+
+def trial_join(in_fa, tmp, ref, ranges, mkargs=_base_args,
+               scale=False):
+    """One worker runs; a second joins mid-run via the --join path."""
+    import threading
+
+    from ccsx_tpu.pipeline import fleet as fleet_mod
+    from ccsx_tpu.pipeline.supervisor import fleet_join
+
+    out = os.path.join(tmp, "o_join.fa")
+    d = fleet_mod.fleet_dir_for(out)
+    join_rc = []
+
+    def joiner():
+        for _ in range(600):
+            if fleet_mod.load_fleet(d):
+                break
+            time.sleep(0.05)
+        join_rc.append(fleet_join(
+            d, 1, poll_s=0.1,
+            env=dict(os.environ, CCSX_JOURNAL_FSYNC_S="0")))
+
+    t = threading.Thread(target=joiner)
+    t.start()
+    rc, wall = _fleet_run(in_fa, out, 1, ranges, mkargs, scale)
+    t.join()
+    got = open(out, "rb").read() if os.path.exists(out) else b""
+    return {"kind": "join", "hosts": "1+1", "ranges": ranges, "rc": rc,
+            "join_rc": join_rc, "wall_s": round(wall, 2),
+            "identical": got == ref,
+            "ok": rc == 0 and join_rc == [0] and got == ref}
+
+
+def run_trials(seed: int, holes: int, ranges: int = 0,
+               scale64: bool = False, tmp: str = None) -> dict:
+    """The soak: fault-free K=1 and K=4 walls (scale-out efficiency),
+    then the churn trials — SIGKILL at halfway with zero restart
+    budget, SIGTERM drain, mid-run join, and a straggler — every one
+    against the byte-identity oracle."""
+    os.environ.setdefault("CCSX_FAULT_STALL_S", "3")
+    rng = np.random.default_rng(seed)
+    own_tmp = tmp is None
+    tmp = tmp or tempfile.mkdtemp(prefix="ccsx_fleet_")
+    t0 = time.monotonic()
+    results = []
+    try:
+        if scale64:
+            in_fa = make_scale64_corpus(tmp)
+            ref = run_scale64_reference(in_fa, tmp)
+            md5 = hashlib.md5(ref).hexdigest()
+            pin_ok = md5 == SCALE64_MD5 and len(ref) == SCALE64_BYTES
+            results.append({"kind": "scale64_pin", "md5": md5,
+                            "bytes": len(ref), "ok": pin_ok})
+            mkargs = _scale64_args
+        else:
+            in_fa = make_corpus(tmp, rng, holes)
+            ref = run_reference(in_fa, tmp)
+            mkargs = _base_args
+        m = ranges or max(8, holes // 2)
+        half = max(1, holes // 8)   # ~halfway through a K=4 worker's share
+        results.append(_trial("plain_k1", in_fa, tmp, ref, 1, m,
+                              mkargs, scale64))
+        results.append(_trial("plain_k4", in_fa, tmp, ref, 4, m,
+                              mkargs, scale64))
+        results.append(_trial(
+            "kill_halfway_k4", in_fa, tmp, ref, 4, m, mkargs, scale64,
+            max_restarts=0,
+            first_launch_env={1: {"CCSX_FAULTS": f"rank_death@{half}"}}))
+        results.append(_trial(
+            "drain_k2", in_fa, tmp, ref, 2, m, mkargs, scale64,
+            max_restarts=0,
+            first_launch_env={1: {"CCSX_FAULTS": "sigterm@1"}}))
+        results.append(trial_join(in_fa, tmp, ref, m, mkargs, scale64))
+        # straggler: worker 1's dispatches stall CCSX_FAULT_STALL_S
+        # each — the fast workers must absorb its share via the lease
+        # queue, and the bytes must not care
+        results.append(_trial(
+            "straggler_k4", in_fa, tmp, ref, 4, m, mkargs, scale64,
+            first_launch_env={1: {"CCSX_FAULTS": "stall@1+"}}))
+    finally:
+        if own_tmp:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+    by = {r["kind"]: r for r in results}
+    walls = {k: by[k]["wall_s"] for k in
+             ("plain_k1", "plain_k4", "kill_halfway_k4")
+             if k in by and by[k].get("wall_s")}
+    derived = {}
+    if "plain_k1" in walls and "plain_k4" in walls:
+        derived["scaleout_k4"] = round(
+            walls["plain_k1"] / walls["plain_k4"], 3)
+    if "plain_k4" in walls and "kill_halfway_k4" in walls:
+        derived["kill_overhead_x"] = round(
+            walls["kill_halfway_k4"] / walls["plain_k4"], 3)
+    bad = [r for r in results if not r["ok"]]
+    return {"seed": seed, "holes": (64 if scale64 else holes),
+            "scale64": scale64, "trials": results,
+            "n_trials": len(results), "n_failed": len(bad),
+            "derived": derived, "ok": not bad,
+            "elapsed_s": round(time.monotonic() - t0, 1)}
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Fleet chaos soak: leased-range scheduling under "
+                    "rank SIGKILL / drain / join / straggler churn, "
+                    "byte-identity oracle (seeded, replayable)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--holes", type=int, default=6)
+    ap.add_argument("--ranges", type=int, default=0,
+                    help="M (0 = max(8, holes//2))")
+    ap.add_argument("--scale64", action="store_true",
+                    help="run over the pinned 64-hole scale config "
+                         "(the acceptance corpus) instead of the "
+                         "small seeded corpus")
+    ap.add_argument("--json", default=None)
+    a = ap.parse_args()
+    summary = run_trials(a.seed, a.holes, a.ranges, scale64=a.scale64)
+    print(json.dumps(summary, indent=1))
+    if a.json:
+        with open(a.json, "w") as f:
+            json.dump(summary, f, indent=1)
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -91,6 +91,13 @@ TPU extensions (long options):
 --hosts <int> --host-id <int> --coordinator <addr> --merge-shards <N>
 --merge-unmarked          (merge a legacy shard set without .done markers)
 --make-index              (index INPUT for byte-range sharded ingest)
+--fleet-dir <dir>         (run as an elastic-fleet pull worker against
+                           <out>.fleet: acquire a leased work-range,
+                           stream it, retire it with a range .done
+                           marker, pull the next; normally launched by
+                           `shepherd --fleet-ranges`, not by hand)
+--fleet-worker <name>     (worker name recorded in leases/markers,
+                           with --fleet-dir) [w<pid>]
 --slab-rows <int>         (ragged pass-packing row budget; default 128)
 --slab-shape-ladder <int> (canonical tail-slab heights per packed shape
                            group: budget >> k for k < N — bounds each
@@ -134,7 +141,17 @@ ccsx-tpu shepherd --hosts N [opts] <INPUT> <OUTPUT>
                            --max-rank-restarts — they resume from
                            their shard journals — then auto-merges;
                            turns merge_shards' "re-run the dead rank"
-                           instruction into a supervised loop)
+                           instruction into a supervised loop.
+                           With --fleet-ranges M the shepherd becomes
+                           the ELASTIC scheduler: the input splits
+                           into M >> N leased work-ranges pulled by
+                           the ranks; a dead rank's ranges requeue to
+                           survivors (no in-place restart needed), a
+                           drained rank (rc 75) is a voluntary leave,
+                           stale leases expire after --lease-timeout
+                           (SIGKILL + requeue), and
+                           `shepherd --join <out>.fleet --hosts K`
+                           adds K workers to a running fleet mid-run)
 ccsx-tpu stats <jsonl>... (summarize --trace / --metrics artifacts:
                            shape-group attribution table, stage
                            breakdown, occupancy recap, slowest
@@ -316,6 +333,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Build INPUT's BGZF hole index sidecar "
                         "(<INPUT>.ccsx_idx) for byte-range sharded "
                         "multi-host ingest, then exit")
+    # elastic fleet plane (pipeline/fleet.py): pull workers over a
+    # leased work-range queue; normally launched by
+    # `ccsx-tpu shepherd --fleet-ranges M`, not by hand
+    p.add_argument("--fleet-dir", default=None, dest="fleet_dir",
+                   metavar="DIR",
+                   help="Run as a fleet pull worker against this "
+                        "fleet directory (<out>.fleet): acquire a "
+                        "range lease, stream it, retire it, pull the "
+                        "next until the queue drains")
+    p.add_argument("--fleet-worker", default=None, dest="fleet_worker",
+                   metavar="NAME",
+                   help="Worker name recorded in leases and range "
+                        "done markers (with --fleet-dir; defaults to "
+                        "w<pid>)")
     # resilient execution (pipeline/resilience.py)
     p.add_argument("--dispatch-deadline", type=float, default=0.0,
                    dest="dispatch_deadline", metavar="SEC",
@@ -573,6 +604,32 @@ def main(argv: Optional[list] = None) -> int:
         except ValueError as e:
             print(f"Error: --inject-faults: {e}", file=sys.stderr)
             return 1
+
+    if args.fleet_dir is not None:
+        # fleet pull worker (pipeline/fleet.py): the fleet dir's
+        # state file is the authority on input/output/ranges; the
+        # scheduler topology flags cannot combine with it
+        if (args.hosts is not None or args.host_id is not None
+                or args.merge_shards is not None or args.make_index):
+            print("Error: --fleet-dir is a pull worker; it cannot "
+                  "combine with --hosts/--host-id/--merge-shards/"
+                  "--make-index (the fleet scheduler owns those)",
+                  file=sys.stderr)
+            return 1
+        if args.bam_out:
+            print("Error: --bam is not supported with --fleet-dir "
+                  "(use --fastq and convert the merged output)",
+                  file=sys.stderr)
+            return 1
+        if args.batch == "off":
+            print("Error: --batch off is not supported with "
+                  "--fleet-dir", file=sys.stderr)
+            return 1
+        from ccsx_tpu.pipeline.fleet import run_fleet_worker
+
+        return run_fleet_worker(args.fleet_dir, cfg,
+                                worker=args.fleet_worker,
+                                inflight=args.inflight)
 
     # imports deferred so --help stays fast and backend selection happens
     # after the config is known

@@ -245,6 +245,16 @@ def hole_range(n_holes: int, rank: int, n: int) -> Tuple[int, int]:
     return (rank * n_holes) // n, ((rank + 1) * n_holes) // n
 
 
+def split_ranges(n_holes: int, m: int) -> List[Tuple[int, int]]:
+    """The raw-hole ordinal space as M contiguous ranges — the fleet
+    scheduler's work-unit table (pipeline/fleet.py).  Same arithmetic
+    as hole_range, so a fleet run with M == N degenerates to exactly
+    the static shard split; empty ranges (m > n_holes) are kept so the
+    table always has m rows and range i's identity never depends on the
+    corpus size."""
+    return [hole_range(n_holes, i, m) for i in range(max(1, m))]
+
+
 def read_hole_range(path: str, idx: dict, lo: int, hi: int,
                     counter=None,
                     max_record_bytes: int = 0) -> Iterator[FastxRecord]:

@@ -37,7 +37,8 @@ from typing import Iterator, Optional
 
 from ccsx_tpu.config import CcsConfig
 from ccsx_tpu.io import fastx
-from ccsx_tpu.utils.journal import Journal, write_json_atomic
+from ccsx_tpu.utils.journal import (Journal, write_json_atomic,
+                                    write_json_exclusive)
 from ccsx_tpu.utils.metrics import Metrics
 
 
@@ -81,7 +82,8 @@ def done_path(out_path: str, rank: int) -> str:
 
 
 def _write_done_marker(out_path: str, rank: int, n: int,
-                       holes_done: int) -> None:
+                       holes_done: int, extra: Optional[dict] = None,
+                       exclusive: bool = False) -> bool:
     # records counted from the closed (fsynced) ordinal sidecar, so a
     # resumed run's marker covers prior runs' records too
     records = 0
@@ -94,10 +96,21 @@ def _write_done_marker(out_path: str, rank: int, n: int,
     # the marker VOUCHES for the shard bytes — merge_shards trusts its
     # existence — so it must never become durable while unfsynced shard
     # data could still be lost to a power cut; ShardWriter.close fsyncs
-    # both shard files first
-    write_json_atomic(done_path(out_path, rank),
-                      {"rank": rank, "hosts": n, "records": records,
-                       "holes_done": holes_done})
+    # both shard files first.  ``extra``: the fleet plane's provenance
+    # fields (range table hash, worker identity, [lo,hi)) ride in the
+    # same marker so merge_shards can refuse stale-table markers.
+    obj = {"rank": rank, "hosts": n, "records": records,
+           "holes_done": holes_done}
+    if extra:
+        obj.update(extra)
+    if exclusive:
+        # fleet ranges commit through the exclusive fence: exactly one
+        # of any number of racing retirers publishes the marker
+        # (write_json_exclusive; the loser's False means someone else
+        # already vouched for this range)
+        return write_json_exclusive(done_path(out_path, rank), obj)
+    write_json_atomic(done_path(out_path, rank), obj)
+    return True
 
 
 class ShardWriter:
@@ -114,7 +127,8 @@ class ShardWriter:
     """
 
     def __init__(self, out_path: str, rank: int, n: int, append: bool,
-                 start_ordinal: int | None = None):
+                 start_ordinal: int | None = None,
+                 mode_header: str | None = None):
         self.rank, self.n = rank, n
         self.start_ordinal = start_ordinal
         mode = "a" if append else "w"
@@ -133,9 +147,13 @@ class ShardWriter:
             # BGZF index sidecar may be fresh on one host and stale on
             # another); a mixed-mode run would interleave overlapping
             # ordinal spaces into a silently corrupt merge, so each
-            # shard declares its mode and merge_shards refuses a mix
-            hdr = ("#mode=range\n" if start_ordinal is not None
-                   else "#mode=rr\n")
+            # shard declares its mode and merge_shards refuses a mix.
+            # The fleet plane passes its own header ("#mode=lease/<table
+            # hash>", pipeline/fleet.py) so leased-range outputs can
+            # never be merged with static shards or a different split.
+            hdr = mode_header if mode_header is not None else (
+                "#mode=range\n" if start_ordinal is not None
+                else "#mode=rr\n")
             self._idx.write(hdr)
             self.idx_bytes_out += len(hdr)
 
@@ -327,7 +345,8 @@ def run_pipeline_sharded(in_path: str, out_path: str, cfg: CcsConfig,
 
 
 def merge_shards(out_path: str, n: int, cleanup: bool = True,
-                 allow_unmarked: bool = False) -> int:
+                 allow_unmarked: bool = False,
+                 expect_table: Optional[str] = None) -> int:
     """K-way merge of <out>.shard0..n-1 by global hole ordinal into
     out_path; returns the record count.  Restores exactly the single-host
     output order.
@@ -339,8 +358,16 @@ def merge_shards(out_path: str, n: int, cleanup: bool = True,
     includes ALL ranks missing (a node-wide kill looks exactly like a
     pre-marker legacy shard set, and guessing "legacy" would silently
     drop holes); a caller who KNOWS the set is legacy-complete passes
-    ``allow_unmarked=True``."""
+    ``allow_unmarked=True``.
+
+    Leased-range sets (fleet runs, pipeline/fleet.py) carry a range
+    table hash both in the shard's idx mode header and in its done
+    marker: the two must agree (a stale marker from a previous run with
+    a different M must not vouch for these bytes), and when the caller
+    knows the live table it passes ``expect_table`` to refuse any
+    foreign split outright."""
     dead = []
+    markers: dict = {}
     for r in range(n):
         if os.path.exists(done_path(out_path, r)):
             # the marker records the host count its run was sharded
@@ -349,7 +376,8 @@ def merge_shards(out_path: str, n: int, cleanup: bool = True,
             # drop shards N..K-1's holes — refuse the mismatch instead
             try:
                 with open(done_path(out_path, r)) as f:
-                    hosts = json.load(f).get("hosts")
+                    markers[r] = json.load(f)
+                hosts = markers[r].get("hosts")
             except (OSError, ValueError):
                 hosts = None  # unreadable marker: can't vouch -> dead
             if hosts == n:
@@ -390,13 +418,44 @@ def merge_shards(out_path: str, n: int, cleanup: bool = True,
     modes = {shard_mode(r) for r in range(n)}
     if len(modes) > 1:
         # one rank ran byte-range sharding while another round-robined
-        # (e.g. the BGZF index sidecar was fresh on one host only):
-        # their ordinal spaces overlap, so a merge would silently drop
-        # and duplicate holes — refuse instead
+        # (e.g. the BGZF index sidecar was fresh on one host only), or
+        # a static-shard output set got mixed with leased-range shards
+        # from a fleet run: their ordinal spaces overlap, so a merge
+        # would silently drop and duplicate holes — refuse instead
+        detail = ("a static-shard run's outputs are mixed with a fleet "
+                  "run's leased ranges; re-run one of them, don't merge "
+                  "across schedulers"
+                  if any(m.startswith("#mode=lease") for m in modes)
+                  else "re-run all ranks with a consistent .ccsx_idx "
+                       "sidecar (or none)")
         raise ValueError(
             f"shards disagree on sharding mode ({sorted(modes)}); "
-            "re-run all ranks with a consistent .ccsx_idx sidecar "
-            "(or none)")
+            f"{detail}")
+    mode = next(iter(modes)) if modes else "#mode=rr"
+    if mode.startswith("#mode=lease/"):
+        # leased-range set: every marker's recorded range table must
+        # match the split the shard was actually written under (the idx
+        # header) — a stale marker from a previous run with a different
+        # M must not vouch for these bytes — and, when given, the live
+        # table the scheduler expects
+        table = mode[len("#mode=lease/"):]
+        if expect_table is not None and table != expect_table:
+            raise ValueError(
+                f"leased shards were written under range table {table} "
+                f"but this run's split is {expect_table}; stale outputs "
+                "from a different -M split cannot be merged — re-run")
+        for r in range(n):
+            mt = markers.get(r, {}).get("table")
+            if mt != table:
+                raise ValueError(
+                    f"shard{r}'s completion marker records range table "
+                    f"{mt}, but the shard was written under {table}; a "
+                    "stale marker from a different split cannot vouch "
+                    "for these bytes — re-run the range")
+    elif expect_table is not None:
+        raise ValueError(
+            f"expected a leased-range shard set (table {expect_table}) "
+            f"but found mode {mode}; refusing to merge")
 
     def records(rank: int):
         p = shard_path(out_path, rank)

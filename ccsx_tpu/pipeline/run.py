@@ -131,6 +131,53 @@ def guarded_stream(stream, cfg: CcsConfig, metrics, guard=None):
         yield z
 
 
+def count_raw_holes(in_path: str, cfg: CcsConfig) -> int:
+    """RAW hole count of the input — the fleet scheduler's range-table
+    denominator (pipeline/fleet.py).  BAM inputs use (or build) the
+    BGZF hole index sidecar; FASTA/Q inputs take one name-only counting
+    pass using the same consecutive-(movie,hole) keying as the sharded
+    BAM indexer, so range-table ordinals always line up with what
+    ``slice_raw_holes`` streams."""
+    from ccsx_tpu.io import bamindex
+
+    if cfg.is_bam:
+        idx = bamindex.load_index(in_path) or bamindex.build_index(
+            in_path,
+            max_record_bytes=getattr(cfg, "max_record_bytes", 0))
+        return idx["n_holes"]
+    n = 0
+    prev = None
+    with open(in_path, "rb") as f:
+        for rec in fastx.read_fastx(f):
+            key = bamindex._hole_key(rec.name)
+            if key != prev:
+                n += 1
+                prev = key
+    return n
+
+
+def slice_raw_holes(records, lo: int, hi: int):
+    """Pass through only the records of raw holes [lo, hi) — the
+    FASTA/Q twin of bamindex.read_hole_range (which seeks; plain text
+    cannot, so the lead-in is parsed and dropped).  Stops at hole hi,
+    so a front range never pays for the file's tail."""
+    from ccsx_tpu.io import bamindex
+
+    if lo >= hi:
+        return
+    seen = -1
+    prev = None
+    for rec in records:
+        key = bamindex._hole_key(rec.name)
+        if key != prev:
+            seen += 1
+            prev = key
+            if seen >= hi:
+                return
+        if seen >= lo:
+            yield rec
+
+
 def holes_total_hint(in_path: str, cfg: CcsConfig):
     """RAW hole count of the input when cheaply knowable (the BGZF hole
     index sidecar, `ccsx-tpu --make-index`), else None — feeds the

@@ -64,7 +64,8 @@ _RUNNER = ("import sys; from ccsx_tpu.cli import main; "
 
 # shepherd-only flags stripped from the forwarded rank command line
 _SHEPHERD_FLAGS = ("--max-rank-restarts", "--rank-backoff",
-                   "--rank-stall-timeout")
+                   "--rank-stall-timeout", "--fleet-ranges",
+                   "--lease-timeout", "--join")
 
 
 def default_prelude() -> str:
@@ -112,6 +113,12 @@ class _Rank:
     failed: Optional[str] = None
     failed_rc: Optional[int] = None
     last_health: Optional[str] = None
+    # rc-75 bookkeeping: a drained rank is VOLUNTARY preemption, not a
+    # crash — relaunched immediately without touching the restart
+    # budget (preempted suppresses a re-applied first_launch fault);
+    # a fleet worker that drains instead LEAVES (drained)
+    preempted: bool = False
+    drained: bool = False
 
 
 def _beat_paths(out_path: str, journal: str, rank: int) -> List[str]:
@@ -194,7 +201,7 @@ def shepherd_run(in_path: str, out_path: str, hosts: int,
     def launch(st: _Rank) -> None:
         e = dict(base_env)
         rank_fwd = fwd
-        if st.attempts == 0:
+        if st.attempts == 0 and not st.preempted:
             e.update(first_launch_env.get(st.rank, {}))
         else:
             # restarts run clean: injected faults model the FIRST
@@ -280,6 +287,24 @@ def shepherd_run(in_path: str, out_path: str, hosts: int,
                         close_log(st)
                         print(f"[ccsx-tpu] shepherd: rank {st.rank} "
                               "completed", file=sys.stderr)
+                    elif rc == exitcodes.RC_INTERRUPTED:
+                        # graceful drain (rc 75, EX_TEMPFAIL) is
+                        # VOLUNTARY preemption — the rank made its work
+                        # durable and asked to be resumed.  Counting it
+                        # against --max-rank-restarts (like a crash)
+                        # would fail a run that merely got SIGTERMed N
+                        # times by a preemptible-capacity scheduler:
+                        # relaunch immediately, no budget spent, no
+                        # backoff, and never re-arm a first-launch
+                        # fault (st.preempted)
+                        close_log(st)
+                        st.proc = None
+                        st.preempted = True
+                        st.relaunch_at = now
+                        print(f"[ccsx-tpu] shepherd: rank {st.rank} "
+                              "drained (rc 75) — voluntary preemption; "
+                              "relaunching without spending the "
+                              "restart budget", file=sys.stderr)
                     elif rc == exitcodes.RC_FAILED_HOLES:
                         # a failed-hole budget abort is DETERMINISTIC:
                         # the journal carries the failure count across
@@ -364,6 +389,351 @@ def shepherd_run(in_path: str, out_path: str, hosts: int,
     return exitcodes.RC_OK
 
 
+def _spawn_worker(cmd: List[str], env: dict, log_path: str,
+                  banner: str):
+    """Launch one fleet worker with a per-worker append log; an
+    unwritable log degrades to DEVNULL (same contract as the static
+    shepherd's launch)."""
+    try:
+        log = open(log_path, "a", encoding="utf-8")
+        log.write(banner)
+        log.flush()
+        sink = log
+    except OSError as e:
+        print(f"[ccsx-tpu] fleet: cannot open {log_path} ({e}); "
+              "worker output discarded", file=sys.stderr)
+        log = None
+        sink = subprocess.DEVNULL
+    proc = subprocess.Popen(cmd, env=env, stdout=sink,
+                            stderr=subprocess.STDOUT)
+    return proc, log
+
+
+def fleet_run(in_path: str, out_path: str, cfg, hosts: int,
+              forward_args: List[str],
+              ranges: int = 0,
+              lease_timeout: float = 10.0,
+              max_restarts: int = 2,
+              backoff_s: float = 1.0,
+              telemetry_port: int = 0,
+              env: Optional[dict] = None,
+              first_launch_env: Optional[Dict[int, dict]] = None,
+              poll_s: float = 0.25,
+              merge: bool = True,
+              runner_prelude: Optional[str] = None) -> int:
+    """The elastic scheduler (`ccsx-tpu shepherd --fleet-ranges M`):
+    split the input into M >> N leased ranges (pipeline/fleet.py),
+    launch ``hosts`` pull workers, and supervise the QUEUE rather than
+    fixed rank assignments:
+
+    * a worker death immediately requeues its leased range(s) to the
+      survivors (fast rebalance — no in-place restart needed; the
+      worker is also relaunched while its restart budget lasts, as an
+      optimization, never a requirement while others live);
+    * leases whose heartbeat goes stale past ``lease_timeout`` are
+      expired — local holder SIGKILLed first, then the lease is
+      renamed away (kill-before-steal) — covering workers the
+      scheduler did not launch (mid-run ``--join``);
+    * rc 75 from a worker is a voluntary leave (graceful drain): its
+      leases are already released, survivors absorb the queue;
+    * when all M range markers are in, the ordinary
+      ``merge_shards(out, M)`` restores the byte-identical output and
+      the fleet dir is cleaned up.
+
+    Returns 0 on merge, 75 when the whole fleet drained with the queue
+    unfinished (re-run the same command to resume), 2/1 on failures
+    (taxonomy preserved, like the static shepherd)."""
+    import shutil
+
+    from ccsx_tpu.parallel.distributed import merge_shards
+    from ccsx_tpu.pipeline import fleet
+    from ccsx_tpu.pipeline.run import count_raw_holes
+    from ccsx_tpu.utils.metrics import Metrics
+
+    if hosts < 1:
+        print("Error: fleet needs --hosts >= 1", file=sys.stderr)
+        return exitcodes.RC_FATAL
+    base_env = dict(os.environ if env is None else env)
+    prelude = (default_prelude() if runner_prelude is None
+               else runner_prelude)
+    first_launch_env = dict(first_launch_env or {})
+    try:
+        n_holes = count_raw_holes(in_path, cfg)
+    except (OSError, RuntimeError, ValueError) as e:
+        print(f"Error: Failed to open infile! ({e})", file=sys.stderr)
+        return exitcodes.RC_FATAL
+    # M >> N by default: enough granularity that a lost rank requeues
+    # ~one range, not 1/N of the run; explicit --fleet-ranges pins it
+    m = ranges if ranges > 0 else max(hosts,
+                                      min(max(n_holes, 1), 4 * hosts))
+    d = fleet.fleet_dir_for(out_path)
+    # workers pull their WHOLE config from the forwarded argv; the
+    # scheduler-only topology flags must not reach them (--hosts would
+    # trip the static sharded path, --journal the per-rank injection —
+    # fleet resume lives in the per-range journals)
+    worker_fwd = strip_shepherd_flags(
+        list(forward_args), flags=("--hosts", "--journal"))
+    try:
+        state = fleet.init_fleet(d, in_path, out_path, n_holes, m,
+                                 lease_timeout,
+                                 forward_args=worker_fwd)
+    except (OSError, ValueError) as e:
+        print(f"Error: fleet init failed: {e}", file=sys.stderr)
+        return exitcodes.RC_FATAL
+    m = len(state["ranges"])
+    table = state["table"]
+    metrics = Metrics(verbose=False)
+    telem = None
+    if telemetry_port:
+        from ccsx_tpu.utils import telemetry as telemetry_mod
+
+        telem = telemetry_mod.start(metrics, telemetry_port)
+    steals = 0
+    rebalances = 0
+    expiry_seq = 0
+
+    def launch(w: _Rank) -> None:
+        e = dict(base_env)
+        wf = worker_fwd
+        if w.attempts == 0 and not w.preempted:
+            e.update(first_launch_env.get(w.rank, {}))
+        else:
+            e.pop("CCSX_FAULTS", None)
+            wf = strip_shepherd_flags(worker_fwd,
+                                      flags=("--inject-faults",))
+        name = f"w{w.rank}"
+        cmd = [sys.executable, "-c", prelude + _RUNNER, *wf,
+               "--fleet-dir", d, "--fleet-worker", name]
+        log_path = f"{out_path}.fleet.{name}.log"
+        banner = (f"\n=== fleet launch worker {name} attempt "
+                  f"{w.attempts} @ {time.strftime('%H:%M:%S')} ===\n")
+        w.proc, w.log = _spawn_worker(cmd, e, log_path, banner)
+        w.relaunch_at = None
+        print(f"[ccsx-tpu] fleet: worker {name} up (pid {w.proc.pid}, "
+              f"attempt {w.attempts}, log {log_path})", file=sys.stderr)
+
+    def close_log(w: _Rank) -> None:
+        if w.log is not None:
+            try:
+                w.log.close()
+            except OSError:
+                pass
+            w.log = None
+
+    workers = [_Rank(rank=i) for i in range(hosts)]
+    for w in workers:
+        launch(w)
+    qs = {"done": 0, "leased": 0, "queued": m}
+    try:
+        while True:
+            now = time.monotonic()
+            qs = fleet.queue_state(d, out_path, m)
+            if qs["done"] >= m:
+                break
+            live = pending = 0
+            for w in workers:
+                if w.done:
+                    continue
+                if w.proc is None:
+                    if w.relaunch_at is not None:
+                        if now >= w.relaunch_at:
+                            launch(w)
+                            live += 1
+                        else:
+                            pending += 1
+                    continue
+                rc = w.proc.poll()
+                if rc is None:
+                    live += 1
+                    continue
+                pid = w.proc.pid
+                close_log(w)
+                w.proc = None
+                if rc == 0:
+                    w.done = True
+                    print(f"[ccsx-tpu] fleet: worker w{w.rank} "
+                          "completed", file=sys.stderr)
+                elif rc == exitcodes.RC_INTERRUPTED:
+                    # voluntary leave: the drain released its lease
+                    # with the range journal durable — the queue keeps
+                    # the work, the survivors absorb it
+                    w.done = True
+                    w.drained = True
+                    print(f"[ccsx-tpu] fleet: worker w{w.rank} drained "
+                          "(rc 75) — voluntary leave; its ranges stay "
+                          "queued for the survivors", file=sys.stderr)
+                elif rc == exitcodes.RC_FAILED_HOLES:
+                    w.done = True
+                    w.failed = (f"worker w{w.rank} exceeded its "
+                                "--max-failed-holes budget (rc "
+                                f"{rc}); not restartable")
+                    w.failed_rc = rc
+                    print(f"[ccsx-tpu] fleet: {w.failed}",
+                          file=sys.stderr)
+                else:
+                    # fast rebalance: the worker is KNOWN dead — free
+                    # its leases now, don't wait out the lease timeout
+                    freed = fleet.reclaim_worker_leases(d, m, pid)
+                    if freed:
+                        steals += len(freed)
+                        rebalances += 1
+                        print(f"[ccsx-tpu] fleet: worker w{w.rank} "
+                              f"died (rc {rc}); requeued range(s) "
+                              f"{freed} for the survivors",
+                              file=sys.stderr)
+                    if w.attempts >= max_restarts:
+                        # out of budget: the worker LEAVES; this only
+                        # fails the run if nobody is left to drain the
+                        # queue
+                        w.done = True
+                        w.failed = (f"worker w{w.rank} died (rc {rc}) "
+                                    "and exhausted its "
+                                    f"{max_restarts} restart(s)")
+                        w.failed_rc = rc
+                        print(f"[ccsx-tpu] fleet: {w.failed}",
+                              file=sys.stderr)
+                    else:
+                        w.attempts += 1
+                        delay = backoff_s * (2 ** (w.attempts - 1))
+                        w.relaunch_at = now + delay
+                        pending += 1
+                        print(f"[ccsx-tpu] fleet: worker w{w.rank} "
+                              f"died (rc {rc}); relaunching in "
+                              f"{delay:g}s (attempt {w.attempts}/"
+                              f"{max_restarts})", file=sys.stderr)
+            # timeout expiry: covers holders the scheduler did NOT
+            # launch (joined workers, leaked pids) — kill-before-steal
+            for i in range(m):
+                ev = fleet.expire_lease(d, i, lease_timeout,
+                                        seq=expiry_seq)
+                expiry_seq += 1
+                if ev is not None:
+                    steals += 1
+                    rebalances += 1
+                    print(f"[ccsx-tpu] fleet: lease on range {i} "
+                          f"expired (holder "
+                          f"{ev.get('worker', '<torn>')}); requeued",
+                          file=sys.stderr)
+            # fleet gauges: scraped via /metrics and `ccsx-tpu top`
+            metrics.fleet_ranges_total = m
+            metrics.fleet_ranges_queued = qs["queued"]
+            metrics.fleet_ranges_leased = qs["leased"]
+            metrics.fleet_ranges_retired = qs["done"]
+            metrics.fleet_ranks_alive = live
+            metrics.fleet_steals = steals
+            metrics.fleet_rebalances = rebalances
+            if live == 0 and pending == 0:
+                break
+            time.sleep(poll_s)
+    finally:
+        for w in workers:
+            if w.proc is not None and w.proc.poll() is None:
+                w.proc.kill()
+                try:
+                    w.proc.wait(timeout=10.0)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+            close_log(w)
+        if telem is not None:
+            telem.close()
+    metrics.fleet_ranges_retired = qs["done"]
+    if qs["done"] < m:
+        failed = [w for w in workers if w.failed]
+        if failed:
+            print("Error: fleet run failed: "
+                  + "; ".join(w.failed for w in failed)
+                  + f" — {qs['done']}/{m} ranges retired; their "
+                  "journals and markers are intact; fix the cause and "
+                  "re-run the shepherd to resume", file=sys.stderr)
+            rcs = {w.failed_rc for w in failed}
+            if rcs == {exitcodes.RC_FAILED_HOLES}:
+                return exitcodes.RC_FAILED_HOLES
+            return exitcodes.RC_FATAL
+        # nobody failed: the whole fleet drained away (SIGTERM) with
+        # the queue unfinished — resumable, rc 75 like a drained rank
+        print(f"[ccsx-tpu] fleet: drained with {qs['done']}/{m} ranges "
+              "retired; re-run the same command to resume",
+              file=sys.stderr)
+        return exitcodes.RC_INTERRUPTED
+    if not merge:
+        return exitcodes.RC_OK
+    try:
+        n = merge_shards(out_path, m, expect_table=table)
+    except (OSError, ValueError) as e:
+        print(f"Error: fleet merge refused: {e}", file=sys.stderr)
+        return exitcodes.RC_FATAL
+    print(f"[ccsx-tpu] fleet: merged {n} records from {m} leased "
+          f"ranges ({hosts} worker(s))", file=sys.stderr)
+    shutil.rmtree(d, ignore_errors=True)
+    return exitcodes.RC_OK
+
+
+def fleet_join(d: str, hosts: int,
+               env: Optional[dict] = None,
+               poll_s: float = 0.25,
+               runner_prelude: Optional[str] = None) -> int:
+    """`ccsx-tpu shepherd --join <out>.fleet --hosts K`: add K pull
+    workers to a RUNNING fleet mid-run.  Subordinate by design — the
+    primary scheduler owns expiry and the merge; a joiner just pulls
+    from the same queue (its workers' argv comes from fleet.json, so
+    the config is exactly the primary's).  Exits 0 when its workers
+    finish (the queue drained or was finished by others)."""
+    from ccsx_tpu.pipeline import fleet
+
+    state = fleet.load_fleet(d)
+    if state is None:
+        print(f"Error: {d} has no readable fleet state (is the fleet "
+              "running? start one with --fleet-ranges)", file=sys.stderr)
+        return exitcodes.RC_FATAL
+    base_env = dict(os.environ if env is None else env)
+    prelude = (default_prelude() if runner_prelude is None
+               else runner_prelude)
+    out_path = state["output"]
+    procs = []
+    logs = []
+    for k in range(hosts):
+        name = f"j{os.getpid()}w{k}"
+        cmd = [sys.executable, "-c", prelude + _RUNNER,
+               *state.get("forward", []),
+               "--fleet-dir", d, "--fleet-worker", name]
+        log_path = f"{out_path}.fleet.{name}.log"
+        banner = (f"\n=== fleet join worker {name} @ "
+                  f"{time.strftime('%H:%M:%S')} ===\n")
+        proc, log = _spawn_worker(cmd, base_env, log_path, banner)
+        procs.append(proc)
+        logs.append(log)
+        print(f"[ccsx-tpu] fleet: joined worker {name} (pid "
+              f"{proc.pid}, log {log_path})", file=sys.stderr)
+    rc = exitcodes.RC_OK
+    try:
+        while any(p.poll() is None for p in procs):
+            time.sleep(poll_s)
+        for p in procs:
+            prc = p.returncode
+            if prc in (0, exitcodes.RC_INTERRUPTED):
+                continue
+            if fleet.load_fleet(d) is None:
+                # the primary retired the queue, merged, and removed
+                # the fleet dir while this worker was mid-pull; its
+                # crash is the completion race, not a work failure
+                print(f"[ccsx-tpu] fleet: joined worker (pid {p.pid}) "
+                      f"exited rc {prc} after the primary merged and "
+                      "cleaned up; ignoring", file=sys.stderr)
+                continue
+            rc = exitcodes.RC_FATAL
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for log in logs:
+            if log is not None:
+                try:
+                    log.close()
+                except OSError:
+                    pass
+    return rc
+
+
 def shepherd_main(argv) -> int:
     """The `ccsx-tpu shepherd` subcommand (dispatched from cli.main):
     the ordinary CLI grammar plus the supervisor knobs; everything
@@ -387,6 +757,23 @@ def shepherd_main(argv) -> int:
                         "stale this long; 0 disables — size it above "
                         "your worst cold compile, or prefer the "
                         "rank-level --dispatch-deadline [0]")
+    p.add_argument("--fleet-ranges", type=int, default=0,
+                   dest="fleet_ranges", metavar="M",
+                   help="elastic fleet mode: split the input into M "
+                        "leased work-ranges (M >> --hosts) pulled by "
+                        "the ranks; a dead rank's ranges requeue to "
+                        "the survivors.  0 = classic static "
+                        "shard-per-rank supervision [0]")
+    p.add_argument("--lease-timeout", type=float, default=10.0,
+                   dest="lease_timeout", metavar="SEC",
+                   help="fleet mode: expire (SIGKILL + requeue) a "
+                        "leased range whose heartbeat goes stale this "
+                        "long [10]")
+    p.add_argument("--join", default=None, dest="join", metavar="DIR",
+                   help="join a RUNNING fleet: launch --hosts extra "
+                        "pull workers against DIR (<out>.fleet); the "
+                        "primary shepherd keeps owning expiry and the "
+                        "merge")
     args = p.parse_args(argv)
     if args.help:
         return cli_mod.usage()
@@ -394,6 +781,10 @@ def shepherd_main(argv) -> int:
         print("Error: shepherd requires --hosts N (>= 1)",
               file=sys.stderr)
         return exitcodes.RC_FATAL
+    if args.join:
+        # the joiner's workers take their whole argv from fleet.json,
+        # so nothing else on this command line applies
+        return fleet_join(args.join, args.hosts)
     if args.host_id is not None:
         print("Error: shepherd owns --host-id; do not pass it",
               file=sys.stderr)
@@ -421,10 +812,18 @@ def shepherd_main(argv) -> int:
     # validate the shared config once up front (same errors the ranks
     # would produce N times over)
     try:
-        cli_mod.config_from_args(args)
+        cfg = cli_mod.config_from_args(args)
     except SystemExit as e:
         return int(e.code or 0)
     forward = strip_shepherd_flags(list(argv))
+    if args.fleet_ranges:
+        return fleet_run(
+            args.input, args.output, cfg, args.hosts, forward,
+            ranges=args.fleet_ranges,
+            lease_timeout=args.lease_timeout,
+            max_restarts=args.max_rank_restarts,
+            backoff_s=args.rank_backoff,
+            telemetry_port=args.telemetry_port or 0)
     return shepherd_run(
         args.input, args.output, args.hosts, forward,
         journal=args.journal,

@@ -24,7 +24,11 @@ import os
 # modules whose behavior shapes consensus OUTPUT bytes.  io/parallel are
 # deliberately out: how bytes are parsed in or sharded across hosts is
 # pinned byte-identical by tests, and including them would invalidate
-# checkpoints on changes that cannot alter output.
+# checkpoints on changes that cannot alter output.  pipeline/fleet.py
+# rides in via the pipeline dir, so a leased-range journal (fleet mode
+# stamps its split into the journal's input_id: in#lease<i>/<m>@<table>)
+# is additionally invalidated by fleet-scheduler changes — conservative,
+# never stale.
 _SRC_DIRS = ("consensus", "ops", "pipeline")
 
 # CcsConfig fields that tile/observe but never change output bytes

@@ -76,6 +76,44 @@ def write_json_atomic(path: str, obj: dict, pre_replace_hook=None) -> None:
         pass
 
 
+def write_json_exclusive(path: str, obj: dict) -> bool:
+    """Crash-safe EXCLUSIVE small-JSON commit (the fleet plane's
+    done-marker fence, pipeline/fleet.py): like write_json_atomic, but
+    the publish step is ``os.link`` — which fails with EEXIST instead
+    of replacing — so exactly ONE of any number of racing writers can
+    ever commit ``path``.  Returns True when this caller committed,
+    False when someone else already had (the loser must treat the
+    existing marker as authoritative, not overwrite it).
+
+    The tmp name carries the pid so two racers never collide on the
+    staging file either."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    try:
+        os.link(tmp, path)
+        committed = True
+    except FileExistsError:
+        committed = False
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    try:
+        dfd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                      os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
+    return committed
+
+
 @dataclasses.dataclass
 class Journal:
     path: str

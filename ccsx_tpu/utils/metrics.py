@@ -251,6 +251,21 @@ class Metrics:
     prep_queue_depth: int = 0
     prep_queue_peak: int = 0
     prep_threads: int = 0
+    # elastic fleet plane (pipeline/fleet.py + supervisor.fleet_run):
+    # the scheduler's view of the leased-range queue.  ranges_total is
+    # M (the -M split); queued/leased are live gauges over the lease
+    # files; retired counts .done markers observed.  steals counts
+    # expired/reclaimed leases moved to the graveyard (each is a range
+    # another worker may now pick up); rebalances counts reap-time
+    # reclaim sweeps that freed at least one lease (rank loss events
+    # absorbed by the survivors).  All zero outside fleet mode.
+    fleet_ranges_total: int = 0
+    fleet_ranges_queued: int = 0
+    fleet_ranges_leased: int = 0
+    fleet_ranges_retired: int = 0
+    fleet_ranks_alive: int = 0
+    fleet_steals: int = 0
+    fleet_rebalances: int = 0
     # a "progress" JSONL event is emitted every progress_every retired
     # holes (0 disables); "final" is always emitted at report().  The
     # live-telemetry plane also emits one every progress_interval_s
@@ -489,6 +504,13 @@ class Metrics:
             "prep_queue_depth": self.prep_queue_depth,
             "prep_queue_peak": self.prep_queue_peak,
             "prep_threads": self.prep_threads,
+            "fleet_ranges_total": self.fleet_ranges_total,
+            "fleet_ranges_queued": self.fleet_ranges_queued,
+            "fleet_ranges_leased": self.fleet_ranges_leased,
+            "fleet_ranges_retired": self.fleet_ranges_retired,
+            "fleet_ranks_alive": self.fleet_ranks_alive,
+            "fleet_steals": self.fleet_steals,
+            "fleet_rebalances": self.fleet_rebalances,
             "elapsed_s": round(self.elapsed, 3),
             "zmws_per_sec": round(self.zmws_per_sec, 3),
             "progress": self.progress_snapshot(),

@@ -71,6 +71,9 @@ PROM_COUNTERS = (
     "device_hangs", "breaker_trips", "breaker_probes",
     "dp_cells_real", "dp_cells_padded", "distinct_slab_shapes",
     "fused_waves", "ingest_bytes",
+    # elastic fleet plane (pipeline/fleet.py): retired ranges, expired/
+    # reclaimed leases, and reap-time rebalance sweeps
+    "fleet_ranges_retired", "fleet_steals", "fleet_rebalances",
 )
 # snapshot keys exported as gauges (ratios, seconds, rates)
 PROM_GAUGES = (
@@ -83,6 +86,9 @@ PROM_GAUGES = (
     # overlap quality, and the live ready-queue gauges
     "prep_blocked_s", "prep_share", "prep_overlap_share",
     "prep_queue_depth", "prep_queue_peak", "prep_threads",
+    # elastic fleet plane: live leased-range queue + fleet membership
+    "fleet_ranges_total", "fleet_ranges_queued", "fleet_ranges_leased",
+    "fleet_ranks_alive",
 )
 # snapshot keys with dedicated (non-scalar) renderings
 PROM_STRUCTURED = ("groups", "groups_forced", "degraded", "progress",
@@ -103,6 +109,9 @@ TOP_SUM_KEYS = (
     "holes_corrupt", "stalls",
     "windows", "device_dispatches", "oom_resplits", "host_fallbacks",
     "refine_overflows", "device_hangs", "breaker_trips", "ingest_bytes",
+    "fleet_ranges_total", "fleet_ranges_queued", "fleet_ranges_leased",
+    "fleet_ranges_retired", "fleet_ranks_alive", "fleet_steals",
+    "fleet_rebalances",
 )
 # /healthz detail fields (rc-relevant: what an operator triages by)
 HEALTH_DETAIL_KEYS = ("stalls", "oom_resplits", "host_fallbacks",
@@ -454,6 +463,15 @@ def render_top(sources: List[dict], agg: dict, color: bool = True) -> str:
            f"eta {_fmt_eta(agg['eta_s'])}" if agg["pct"] is not None
            else " total unknown — rate only"),
     ]
+    if agg.get("fleet_ranges_total"):
+        lines.append(
+            f"  fleet: ranges {agg['fleet_ranges_retired']}"
+            f"/{agg['fleet_ranges_total']} retired  "
+            f"queued {agg['fleet_ranges_queued']}  "
+            f"leased {agg['fleet_ranges_leased']}  "
+            f"ranks {agg['fleet_ranks_alive']}  "
+            f"steals {agg['fleet_steals']}  "
+            f"rebalances {agg['fleet_rebalances']}")
     if (agg["stalls"] or agg["oom_resplits"] or agg["host_fallbacks"]
             or agg["holes_failed"] or agg["device_hangs"]
             or agg["breaker_trips"]):

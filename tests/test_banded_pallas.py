@@ -62,6 +62,8 @@ def test_bit_exact_random_batch():
     _compare(qs, qlens, ts, tlens, AlignParams())
 
 
+@pytest.mark.slow  # ~15s edge sweep; bit_exact_random_batch and
+# gblock/qmax siblings keep the kernel's tier-1 pin (r13 audit)
 def test_empty_and_extreme_rows():
     """Padding rows (qlen=0), very short queries, and full-length queries."""
     rng = np.random.default_rng(11)

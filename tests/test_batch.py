@@ -59,6 +59,8 @@ def test_executor_matches_per_hole_rounds(rng):
             rb.advance, win_mod._advance(ra, bp_eff).astype(np.int32))
 
 
+@pytest.mark.slow  # ~17s window sweep; per-hole-rounds and the CLI
+# batched==per-hole pin keep the executor tier-1 (r13 audit)
 def test_executor_drives_windowed_gen_to_same_result(rng):
     """Driving the windowed generator with batched results reproduces the
     per-hole windowed consensus exactly."""
@@ -307,6 +309,8 @@ def test_packed_transfer_protocol_matches_unpacked(rng):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow  # ~13s knob A/B; test_packing's packed==bucketed==
+# per-hole CLI pin keeps the invariant tier-1 (r13 audit)
 def test_pass_buckets_knob_output_invariant(tmp_path, rng):
     """--pass-buckets changes only device padding (masked rows), never
     output bytes — the invariance that makes it a safe tuning knob —

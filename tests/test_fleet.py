@@ -1,0 +1,428 @@
+"""Elastic fleet plane (pipeline/fleet.py + supervisor.fleet_run):
+leased work-ranges, crash-safe lease arbitration, rank-loss
+rebalancing, and mid-run fleet membership.
+
+THE acceptance cases pinned here: a K-worker leased-range run merges
+byte-identical to the unsharded reference with (a) no faults, (b) one
+worker SIGKILLed mid-run and ZERO restart budget (its ranges requeue
+to the survivors), (c) one worker SIGTERM-draining mid-run (voluntary
+leave), and (d) one worker joining mid-run (`shepherd --join`).
+
+Lease crash-consistency (satellite): torn leases (SIGKILL between
+O_EXCL create and the owner write), duplicate acquisition races, and
+expired-then-renewed leases all resolve to EXACTLY one owner.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ccsx_tpu import cli, exitcodes
+from ccsx_tpu.io import bamindex
+from ccsx_tpu.parallel import distributed
+from ccsx_tpu.pipeline import fleet, supervisor
+from ccsx_tpu.utils import synth
+from ccsx_tpu.utils.journal import write_json_atomic, write_json_exclusive
+
+
+# ---------- range split + table identity ----------
+
+def test_split_ranges_partitions_and_degenerates():
+    # M ranges tile [0, n) exactly, in order, no overlap
+    rs = bamindex.split_ranges(10, 4)
+    assert rs[0][0] == 0 and rs[-1][1] == 10
+    for (a, b), (c, _) in zip(rs, rs[1:]):
+        assert b == c and a <= b
+    # M == N degenerates to exactly the static shard split
+    assert bamindex.split_ranges(10, 2) == [
+        bamindex.hole_range(10, r, 2) for r in range(2)]
+    # M > n_holes keeps m rows (empty ranges are legal, zero-cost)
+    rs = bamindex.split_ranges(2, 5)
+    assert len(rs) == 5 and rs[0][0] == 0 and rs[-1][1] == 2
+    assert sum(b - a for a, b in rs) == 2
+
+
+def test_table_hash_pins_split_identity(tmp_path):
+    rs4 = bamindex.split_ranges(8, 4)
+    h = fleet.table_hash("in.fa", 8, rs4)
+    assert h != fleet.table_hash("in.fa", 8, bamindex.split_ranges(8, 3))
+    assert h != fleet.table_hash("other.fa", 8, rs4)
+    # basename only: the same input reached via a different mount point
+    # is the same split
+    assert h == fleet.table_hash("/elsewhere/in.fa", 8, rs4)
+
+
+def test_init_fleet_refuses_foreign_table(tmp_path):
+    d = str(tmp_path / "f")
+    st = fleet.init_fleet(d, "in.fa", "out.fa", 8, 4, 5.0, ["-A"])
+    # same split: resume, state preserved
+    again = fleet.init_fleet(d, "in.fa", "out.fa", 8, 4, 5.0)
+    assert again["table"] == st["table"] and again["forward"] == ["-A"]
+    # different M: loud refusal, not silent inheritance
+    with pytest.raises(ValueError, match="different range table"):
+        fleet.init_fleet(d, "in.fa", "out.fa", 8, 3, 5.0)
+
+
+# ---------- lease crash-consistency (satellite) ----------
+
+def test_write_json_exclusive_exactly_one_winner(tmp_path):
+    p = str(tmp_path / "marker")
+    assert write_json_exclusive(p, {"who": "first"}) is True
+    assert write_json_exclusive(p, {"who": "second"}) is False
+    with open(p) as f:
+        assert json.load(f)["who"] == "first"
+
+
+def test_try_acquire_race_admits_exactly_one(tmp_path):
+    d = str(tmp_path)
+    wins = []
+    barrier = threading.Barrier(8)
+
+    def racer(k):
+        barrier.wait()
+        if fleet.try_acquire(d, 0, f"w{k}") is not None:
+            wins.append(k)
+
+    ts = [threading.Thread(target=racer, args=(k,)) for k in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(wins) == 1
+    rec = fleet.read_lease(d, 0)
+    assert rec["worker"] == f"w{wins[0]}"
+
+
+def test_torn_lease_expires_by_mtime_and_readmits_one(tmp_path):
+    """SIGKILL between O_EXCL create and the owner write leaves an
+    empty lease file: it must age by mtime, expire, and be re-acquired
+    by exactly one of any number of racers."""
+    d = str(tmp_path)
+    open(fleet.lease_path(d, 0), "w").close()   # the torn lease
+    assert fleet.read_lease(d, 0) == {}         # unreadable != free
+    # young torn lease: NOT expirable (the owner may still be mid-write)
+    assert fleet.expire_lease(d, 0, timeout_s=60.0) is None
+    old = time.time() - 120
+    os.utime(fleet.lease_path(d, 0), (old, old))
+    assert fleet.expire_lease(d, 0, timeout_s=60.0) == {}
+    # the graveyard holds the evidence; the range is free again
+    assert os.listdir(os.path.join(d, fleet.GRAVEYARD))
+    wins = [w for w in range(4)
+            if fleet.try_acquire(d, 0, f"w{w}") is not None]
+    assert len(wins) == 1
+
+
+def test_expired_then_renewed_lease_stays_owned(tmp_path):
+    """A renewal that lands before the scheduler's expiry check keeps
+    the lease: expiry reads the HEARTBEAT, not the acquire time."""
+    d = str(tmp_path)
+    rec = fleet.try_acquire(d, 0, "w0")
+    # age the acquire time far past any timeout...
+    write_json_atomic(fleet.lease_path(d, 0),
+                      dict(rec, acquired=time.time() - 999,
+                           renewed=time.time() - 999))
+    # ...then renew: the heartbeat bump must rescue it
+    assert fleet.renew(d, 0, rec) is True
+    assert fleet.expire_lease(d, 0, timeout_s=60.0) is None
+    # now let the heartbeat itself go stale: expiry evicts (kill=False:
+    # the holder is this test process)
+    write_json_atomic(fleet.lease_path(d, 0),
+                      dict(rec, renewed=time.time() - 999))
+    evicted = fleet.expire_lease(d, 0, timeout_s=60.0, kill=False)
+    assert evicted is not None and evicted["worker"] == "w0"
+    # the evicted owner's renew must now FAIL (stop-renewing contract)
+    assert fleet.renew(d, 0, rec) is False
+    # and exactly one racer re-acquires the freed range
+    wins = [w for w in range(4)
+            if fleet.try_acquire(d, 0, f"w{w}") is not None]
+    assert len(wins) == 1
+
+
+def test_release_ignores_foreign_lease(tmp_path):
+    d = str(tmp_path)
+    rec = fleet.try_acquire(d, 0, "w0")
+    fleet.release(d, 0, dict(rec, worker="imposter"))
+    assert fleet.read_lease(d, 0) is not None   # still held
+    fleet.release(d, 0, rec)
+    assert fleet.read_lease(d, 0) is None
+
+
+def test_reclaim_worker_leases_frees_only_that_pid(tmp_path):
+    d = str(tmp_path)
+    rec0 = fleet.try_acquire(d, 0, "dead")
+    rec2 = fleet.try_acquire(d, 2, "dead")
+    fleet.try_acquire(d, 1, "alive")
+    write_json_atomic(fleet.lease_path(d, 0), dict(rec0, pid=987654))
+    write_json_atomic(fleet.lease_path(d, 2), dict(rec2, pid=987654))
+    assert fleet.reclaim_worker_leases(d, 3, 987654) == [0, 2]
+    assert fleet.read_lease(d, 0) is None
+    assert fleet.read_lease(d, 1) is not None   # the survivor's lease
+    assert fleet.read_lease(d, 2) is None
+
+
+def test_queue_state_counts(tmp_path):
+    d = str(tmp_path)
+    out = str(tmp_path / "o.fa")
+    fleet.try_acquire(d, 1, "w0")
+    write_json_atomic(distributed.done_path(out, 2), {"rank": 2})
+    assert fleet.queue_state(d, out, 4) == {
+        "done": 1, "leased": 1, "queued": 2}
+
+
+# ---------- merge refusals (satellite) ----------
+
+def _lease_shard(out, i, m, table, name="mv/100/ccs", ordinal=0):
+    with open(distributed.shard_path(out, i), "w") as f:
+        f.write(f">{name}\nACGT\n")
+    with open(distributed.shard_path(out, i) + ".idx", "w") as f:
+        f.write(f"#mode=lease/{table}\n{ordinal}\n")
+    write_json_atomic(distributed.done_path(out, i),
+                      {"rank": i, "hosts": m, "records": 1,
+                       "holes_done": 1, "table": table})
+
+
+def test_merge_refuses_static_lease_mix(tmp_path):
+    out = str(tmp_path / "o.fa")
+    _lease_shard(out, 0, 2, "aaaa", ordinal=0)
+    # shard1 is a static round-robin shard with a marker
+    with open(distributed.shard_path(out, 1), "w") as f:
+        f.write(">mv/101/ccs\nACGT\n")
+    with open(distributed.shard_path(out, 1) + ".idx", "w") as f:
+        f.write("#mode=rr\n1\n")
+    write_json_atomic(distributed.done_path(out, 1),
+                      {"rank": 1, "hosts": 2, "records": 1,
+                       "holes_done": 1})
+    with pytest.raises(ValueError, match="don't merge across schedulers"):
+        distributed.merge_shards(out, 2)
+
+
+def test_merge_refuses_stale_table_marker(tmp_path):
+    """A done marker recorded under a DIFFERENT split cannot vouch for
+    bytes written under this one."""
+    out = str(tmp_path / "o.fa")
+    _lease_shard(out, 0, 2, "aaaa", ordinal=0)
+    _lease_shard(out, 1, 2, "aaaa", name="mv/101/ccs", ordinal=1)
+    marker = distributed.done_path(out, 1)
+    with open(marker) as f:
+        obj = json.load(f)
+    write_json_atomic(marker, dict(obj, table="bbbb"))
+    with pytest.raises(ValueError, match="stale marker"):
+        distributed.merge_shards(out, 2)
+
+
+def test_merge_refuses_foreign_expect_table(tmp_path):
+    out = str(tmp_path / "o.fa")
+    _lease_shard(out, 0, 1, "aaaa")
+    with pytest.raises(ValueError, match="different -M split"):
+        distributed.merge_shards(out, 1, expect_table="bbbb")
+    # and a static set can never satisfy an expected lease table
+    out2 = str(tmp_path / "p.fa")
+    with open(distributed.shard_path(out2, 0), "w") as f:
+        f.write(">mv/100/ccs\nACGT\n")
+    with open(distributed.shard_path(out2, 0) + ".idx", "w") as f:
+        f.write("#mode=rr\n0\n")
+    write_json_atomic(distributed.done_path(out2, 0),
+                      {"rank": 0, "hosts": 1, "records": 1,
+                       "holes_done": 1})
+    with pytest.raises(ValueError, match="expected a leased-range"):
+        distributed.merge_shards(out2, 1, expect_table="aaaa")
+
+
+def test_merge_accepts_consistent_lease_set(tmp_path):
+    out = str(tmp_path / "o.fa")
+    _lease_shard(out, 0, 2, "aaaa", name="mv/100/ccs", ordinal=0)
+    _lease_shard(out, 1, 2, "aaaa", name="mv/101/ccs", ordinal=1)
+    assert distributed.merge_shards(out, 2, expect_table="aaaa") == 2
+    body = open(out).read()
+    assert body.index("mv/100") < body.index("mv/101")
+
+
+# ---------- bench gate (vs_prev fleet leg) ----------
+
+def test_bench_compare_fleet_gates(monkeypatch):
+    import bench
+
+    arts = [("fleet_r13.json", {"scaleout_k4": 1.0,
+                                "kill_overhead_x": 1.2, "ok": True}),
+            ("fleet_r12.json", {"scaleout_k4": 1.5,
+                                "kill_overhead_x": 1.1, "ok": True})]
+    monkeypatch.setattr(bench, "latest_fleet_artifacts",
+                        lambda *a, **k: arts)
+    line, vp, regressed = {}, {}, []
+    bench.compare_fleet(line, None, vp, regressed)
+    # 1.5 -> 1.0 is a >20% scale-out drop: tripped
+    assert line["fleet"]["artifact"] == "fleet_r13.json"
+    assert vp["fleet_scaleout_k4"] == {"prev": 1.5, "cur": 1.0,
+                                       "prev_source": "fleet_r12.json"}
+    assert any("scaleout" in r for r in regressed)
+    # within 20%: clean — and the prev bench line outranks artifact #2
+    arts[0] = ("fleet_r13.json", {"scaleout_k4": 1.45,
+                                  "kill_overhead_x": 1.2, "ok": True})
+    line, vp, regressed = {}, {"fleet": {"scaleout_k4": 1.5}}, []
+    bench.compare_fleet(line, {"fleet": {"scaleout_k4": 1.5}}, vp,
+                        regressed)
+    assert not regressed
+    assert vp["fleet_scaleout_k4"]["prev_source"] == "prev bench line"
+    # a soak with ANY non-byte-identical trial trips regardless of perf
+    arts[0] = ("fleet_r13.json", {"scaleout_k4": 2.0,
+                                  "kill_overhead_x": 1.0, "ok": False})
+    line, vp, regressed = {}, {}, []
+    bench.compare_fleet(line, None, vp, regressed)
+    assert any("non-byte-identical" in r for r in regressed)
+
+
+# ---------- CLI surface ----------
+
+def test_fleet_worker_flag_validation(tmp_path, capsys):
+    d = str(tmp_path / "f")
+    # a pull worker cannot also be a static shard rank / merger / indexer
+    assert cli.main(["--fleet-dir", d, "--hosts", "2",
+                     "in.fa", "o.fa"]) == 1
+    assert "fleet scheduler owns those" in capsys.readouterr().err
+    assert cli.main(["--fleet-dir", d, "--batch", "off",
+                     "in.fa", "o.fa"]) == 1
+    capsys.readouterr()
+    # a worker pointed at a dir with no fleet state fails loudly
+    assert cli.main(["--fleet-dir", d, "in.fa", "o.fa"]) == 1
+    assert "fleet.json" in capsys.readouterr().err
+    # --join with no fleet state is the same story
+    assert supervisor.shepherd_main(
+        ["--join", d, "--hosts", "1", "in.fa", "o.fa"]) == 1
+
+
+# ---------- end-to-end: K workers, faults, byte-identity ----------
+
+@pytest.fixture(scope="module")
+def corpus6(tmp_path_factory):
+    """6 holes / M=4 ranges: every worker holds >1 range over the run,
+    so mid-run kills and drains land while ranges are genuinely
+    outstanding.  Same 700 bp / 5-pass geometry as the other fault
+    suites."""
+    tmp = tmp_path_factory.mktemp("fleet")
+    rng = np.random.default_rng(0)
+    zs = [synth.make_zmw(rng, template_len=700, n_passes=5, movie="mv",
+                         hole=str(100 + h)) for h in range(6)]
+    fa = tmp / "in.fa"
+    fa.write_text(synth.make_fasta(zs))
+    ref = tmp / "ref.fa"
+    assert cli.main(["-A", "-m", "1000", "--batch", "on",
+                     str(fa), str(ref)]) == 0
+    return fa, ref
+
+
+def _fleet(fa, out, hosts, **kw):
+    fwd = ["-A", "-m", "1000", "--batch", "on", str(fa), str(out)]
+    cfg = cli.config_from_args(cli.build_parser().parse_args(fwd))
+    kw.setdefault("env", dict(os.environ, CCSX_JOURNAL_FSYNC_S="0"))
+    return supervisor.fleet_run(
+        str(fa), str(out), cfg, hosts, fwd,
+        ranges=4, lease_timeout=5.0, poll_s=0.1, backoff_s=0.1, **kw)
+
+
+@pytest.mark.slow  # ~24s: the fault-free e2e; the SIGKILL-rebalance
+# case below keeps the leased-range byte pin tier-1 (r13 budget audit)
+def test_fleet_run_no_faults_byte_identical(corpus6, tmp_path, capsys):
+    fa, ref = corpus6
+    out = tmp_path / "o.fa"
+    rc = _fleet(fa, out, 2)
+    err = capsys.readouterr().err
+    assert rc == 0, err
+    assert out.read_bytes() == ref.read_bytes()
+    assert "merged 6 records from 4 leased ranges" in err
+    # the fleet dir is cleaned up after a successful merge
+    assert not os.path.exists(fleet.fleet_dir_for(str(out)))
+
+
+def test_fleet_run_sigkilled_worker_rebalances(corpus6, tmp_path,
+                                               capsys):
+    """THE rank-loss case: worker 1 is SIGKILLed mid-range with ZERO
+    restart budget — the scheduler reclaims its leases immediately
+    (reap-time rebalance, no lease-timeout wait) and the survivor
+    absorbs them; merged bytes stay identical."""
+    fa, ref = corpus6
+    out = tmp_path / "o.fa"
+    rc = _fleet(fa, out, 2, max_restarts=0,
+                first_launch_env={1: {"CCSX_FAULTS": "rank_death@2"}})
+    err = capsys.readouterr().err
+    assert rc == 0, err
+    assert out.read_bytes() == ref.read_bytes()
+    assert "requeued range(s)" in err
+
+
+@pytest.mark.slow
+def test_fleet_run_sigterm_drain_is_voluntary_leave(corpus6, tmp_path,
+                                                    capsys):
+    """A worker that drains (rc 75) leaves the fleet voluntarily: no
+    restart is spent, its unfinished ranges stay queued, the survivors
+    finish, and the merge is byte-identical."""
+    fa, ref = corpus6
+    out = tmp_path / "o.fa"
+    rc = _fleet(fa, out, 2, max_restarts=0,
+                # @1: the drain fires at worker 1's FIRST retirement —
+                # every worker acquires and finishes at least one
+                # (non-empty) range, so the fault cannot be outrun
+                first_launch_env={1: {"CCSX_FAULTS": "sigterm@1"}})
+    err = capsys.readouterr().err
+    assert rc == 0, err
+    assert out.read_bytes() == ref.read_bytes()
+    # the drained worker's own log records the rc-75 leave (the
+    # scheduler's "voluntary leave" line is racy: the queue can empty
+    # before the drained child is reaped); zero restarts were spent
+    # either way, so the fault must have fired and the run still merged
+    log1 = (out.parent / "o.fa.fleet.w1.log").read_text()
+    assert "sigterm" in log1
+    assert "drained" in log1 or "voluntary leave" in err
+
+
+@pytest.mark.slow
+def test_fleet_join_mid_run(corpus6, tmp_path, capsys):
+    """Mid-run membership: a second worker joins a 1-worker fleet via
+    the --join path and the merged output is unchanged."""
+    fa, ref = corpus6
+    out = tmp_path / "o.fa"
+    d = fleet.fleet_dir_for(str(out))
+    join_rc = []
+
+    def joiner():
+        for _ in range(400):
+            if fleet.load_fleet(d):
+                break
+            time.sleep(0.05)
+        join_rc.append(supervisor.fleet_join(
+            d, 1, poll_s=0.1,
+            env=dict(os.environ, CCSX_JOURNAL_FSYNC_S="0")))
+
+    t = threading.Thread(target=joiner)
+    t.start()
+    rc = _fleet(fa, out, 1)
+    t.join()
+    err = capsys.readouterr().err
+    assert rc == 0, err
+    assert out.read_bytes() == ref.read_bytes()
+    assert "joined worker" in err
+    assert join_rc == [0]
+
+
+@pytest.mark.slow
+def test_fleet_run_whole_fleet_drained_resumes(corpus6, tmp_path,
+                                               capsys):
+    """Every worker draining before the queue empties is rc 75 — and
+    re-running the same command RESUMES: the per-range journals carry
+    the durable cursors, so the finish run recomputes only the tails
+    and the final bytes are identical."""
+    fa, ref = corpus6
+    out = tmp_path / "o.fa"
+    rc = _fleet(fa, out, 1, max_restarts=0,
+                first_launch_env={0: {"CCSX_FAULTS": "sigterm@2"}})
+    err = capsys.readouterr().err
+    assert rc == exitcodes.RC_INTERRUPTED, err
+    assert "re-run the same command to resume" in err
+    assert os.path.exists(fleet.fleet_dir_for(str(out)))
+    rc = _fleet(fa, out, 1)
+    err = capsys.readouterr().err
+    assert rc == 0, err
+    assert out.read_bytes() == ref.read_bytes()

@@ -90,6 +90,8 @@ def _run(fa, out, extra, metrics_path=None):
 
 # ---------- byte identity: pool on/off, thread counts, window modes --------
 
+@pytest.mark.slow  # ~35s: 3-arm width A/B; kill-and-resume with a live
+# pool keeps the prep plane's tier-1 byte pin (r13 budget audit)
 def test_pool_on_off_byte_identical(corpus, tmp_path):
     """THE acceptance invariant: inline prep (--prep-threads 0) and any
     pool width produce the reference bytes, and the inline run's

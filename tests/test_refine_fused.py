@@ -59,6 +59,8 @@ def _assert_matches_host(sm, cfg, req, res):
                 want_rr.materialize(upto=host_bp))
 
 
+@pytest.mark.slow  # ~18s 5-spec sweep; the mesh/overflow/headroom
+# siblings and test_packing's CLI parity pin stay tier-1 (r13 audit)
 def test_fused_refine_matches_host_loop(rng):
     """One fused dispatch == the host refinement loop, bitwise, across
     mixed shapes, pass counts, noise levels, and fixpoint holes."""

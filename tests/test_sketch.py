@@ -222,6 +222,9 @@ def _spec_zmws(rng, n=2, tlen=2200):
     return zs
 
 
+@pytest.mark.slow  # ~85s: 6-arm CLI A/B; the filter-oracle fuzz and
+# counter checks stay tier-1, and the scale-config byte pin rides the
+# committed fleet_r13 artifact (r13 budget audit)
 def test_cli_byte_identity_prefilter_arms(tmp_path, rng):
     """Output bytes are invariant to the whole pre-alignment plane:
     prefilter on/off, device seeding off/at-crossover, the per-hole

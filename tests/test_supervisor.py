@@ -111,6 +111,38 @@ def test_shepherd_restarts_sigkilled_rank_and_merges(corpus4, tmp_path,
     assert "rank_death" in log1
 
 
+def test_shepherd_drained_rank_is_not_charged_a_restart(corpus4,
+                                                        tmp_path,
+                                                        capsys):
+    """Satellite fix: a rank that exits rc 75 (SIGTERM graceful drain,
+    journal durable) is a VOLUNTARY preemption — the shepherd must
+    relaunch it immediately without spending the restart budget or
+    backoff.  Before the fix a drained rank burned --max-rank-restarts
+    like a crash, so a maintenance drain could fail the whole run."""
+    fa, ref = corpus4
+    out = tmp_path / "drain.fa"
+    fwd = ["-A", "-m", "1000", "--hosts", "2", str(fa), str(out)]
+    rc = supervisor.shepherd_run(
+        str(fa), str(out), 2, fwd,
+        # zero restart budget: the old (buggy) accounting would fail
+        # the rank on its first drain; voluntary preemption must not
+        # touch this budget at all
+        max_restarts=0, backoff_s=0.1, poll_s=0.1,
+        env=dict(os.environ, CCSX_JOURNAL_FSYNC_S="0"),
+        first_launch_env={1: {"CCSX_FAULTS": "sigterm@1"}})
+    err = capsys.readouterr().err
+    assert rc == 0, err
+    assert out.read_bytes() == ref.read_bytes()
+    assert "voluntary preemption" in err
+    assert "drained (rc 75)" in err
+    # no restart budget/backoff was spent on the drain
+    assert "restarting in" not in err
+    # the relaunch is still attempt 0 (preemption, not a restart) and
+    # runs clean: the sigterm fault must not re-fire on the relaunch
+    log1 = (out.parent / "drain.fa.shard1.log").read_text()
+    assert log1.count("attempt 0") == 2 and "attempt 1" not in log1
+
+
 def test_shepherd_budget_abort_is_not_restarted(corpus4, tmp_path,
                                                 capsys):
     """rc 2 (--max-failed-holes exceeded) is deterministic — the
