@@ -4,7 +4,7 @@
 PY ?= python
 PYTEST_FLAGS = -q -p no:cacheprovider -p no:xdist -p no:randomly
 
-.PHONY: chaos chaos-soak fleet-chaos fuzz fuzz-sweep tier1 native long-molecule
+.PHONY: chaos chaos-soak fleet-chaos fuzz fuzz-sweep tier1 native long-molecule pallas-ab
 
 # the long-template (ultra-long-read) A/B: prefilter + device seeding
 # vs the legacy host path, interleaved arms, bytes asserted identical
@@ -48,6 +48,13 @@ fleet-chaos:
 chaos-soak:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py $(PYTEST_FLAGS)
 	JAX_PLATFORMS=cpu $(PY) benchmarks/chaos.py --seed 0 --trials 8 --holes 4
+
+# the DP-kernel promotion harness, check mode (scan vs Pallas v1 vs
+# rotband v2 bit-identity, interpret mode on CPU).  The timed three-arm
+# run that emits the decision record needs the real chip — it is step 4
+# of benchmarks/tpu_battery.sh, not a make target.
+pallas-ab:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/pallas_ab.py --mode check
 
 # the ROADMAP tier-1 suite (same flags as the verify command)
 tier1:

@@ -202,6 +202,88 @@ def compare_fleet(line, prev, vp, regressed):
             "regression)")
 
 
+def latest_pallas_ab_artifacts(root=_HERE, n=2):
+    """The ``n`` highest-numbered usable benchmarks/pallas_ab*_r*.json
+    artifacts (the scan / Pallas v1 / rotband v2 promotion harness,
+    benchmarks/pallas_ab.py), newest first, as (name, summary) pairs.
+    Usable = carries a "decision" record (winner, margin, per-arm
+    rates), i.e. a --mode time run that produced a verdict; pure
+    --mode check artifacts are skipped."""
+    import glob
+    import re
+
+    cands = []
+    for p in glob.glob(os.path.join(root, "benchmarks",
+                                    "pallas_ab*_r*.json")):
+        m = re.search(r"pallas_ab.*_r(\d+)\.json$", p)
+        if m:
+            cands.append((int(m.group(1)), p))
+    out = []
+    for _, p in sorted(cands, reverse=True):
+        try:
+            with open(p) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            continue
+        dec = d.get("decision")
+        if not isinstance(dec, dict) or not dec.get("winner"):
+            continue
+        out.append((os.path.basename(p),
+                    {"winner": dec.get("winner"),
+                     "margin": dec.get("margin"),
+                     "metric": dec.get("metric"),
+                     "round_rates": dec.get("round_rates"),
+                     "backend": dec.get("backend"),
+                     "interpret": dec.get("interpret")}))
+        if len(out) >= n:
+            break
+    return out
+
+
+def compare_dp_kernel(line, prev, vp, regressed):
+    """The DP-kernel leg of the vs_prev gate: the three-arm promotion
+    record (scan vs Pallas v1 vs rotband v2, marginal-fetch timed)
+    from the newest pallas_ab artifact vs the prior bench line's (or
+    the second-newest artifact).  Absolute rates only compare within
+    the same backend — an interpret-mode CPU record never gates a TPU
+    one.  A winner FLIP is informational (logged into vs_prev, the
+    promotion protocol decides what to do with it); what trips
+    ``regressed`` is the winning arm's throughput dropping >20% on
+    the same backend — the promoted kernel itself got slower."""
+    arts = latest_pallas_ab_artifacts()
+    if arts:
+        name, summary = arts[0]
+        line["dp_kernel"] = {"artifact": name, **summary}
+    cur = line.get("dp_kernel")
+    prev_d = (prev or {}).get("dp_kernel")
+    prev_src = "prev bench line"
+    if prev_d is None and len(arts) > 1:
+        prev_src, prev_d = arts[1]
+    if not cur or not prev_d:
+        return
+    ent = {"prev_winner": prev_d.get("winner"),
+           "cur_winner": cur.get("winner"),
+           "prev_source": prev_src}
+    if cur.get("winner") != prev_d.get("winner"):
+        ent["winner_flipped"] = True
+        print(f"[bench] dp-kernel winner flipped "
+              f"{prev_d.get('winner')} -> {cur.get('winner')} "
+              "(informational; see the promotion protocol in "
+              "ccsx_tpu/consensus/star.py)", file=sys.stderr)
+    if cur.get("backend") == prev_d.get("backend"):
+        w = cur.get("winner")
+        cur_r = (cur.get("round_rates") or {}).get(w)
+        prev_r = (prev_d.get("round_rates") or {}).get(w)
+        if cur_r and prev_r:
+            ent["winner_rate"] = {"prev": prev_r, "cur": cur_r}
+            if cur_r < prev_r * REGRESSION_DROP:
+                regressed.append(
+                    f"dp-kernel winning arm '{w}' "
+                    f"{prev_r:.0f}->{cur_r:.0f} zmw_windows/s "
+                    f"({cur.get('backend')} backend)")
+    vp["dp_kernel"] = ent
+
+
 def compare_with_prev(line, prev, artifact):
     """Mutates ``line``: adds "vs_prev" (ratios vs the prior artifact
     for dp_cells_per_sec and per-config e2e zmws_per_sec) and, on a
@@ -317,10 +399,12 @@ def compare_with_prev(line, prev, artifact):
             vp["zmws_per_sec_configs"] = ratios
             if g < REGRESSION_DROP:
                 regressed.append(f"e2e zmws_per_sec x{g:.2f}")
-    # the quality and fleet legs ride every comparison (both are
-    # backend-independent properties of committed artifacts)
+    # the quality, fleet, and dp-kernel legs ride every comparison
+    # (all gate off committed artifacts; the dp-kernel leg does its
+    # own backend gating internally)
     compare_quality(line, prev, vp, regressed)
     compare_fleet(line, prev, vp, regressed)
+    compare_dp_kernel(line, prev, vp, regressed)
     line["vs_prev"] = vp
     if regressed:
         line["regressed"] = regressed
@@ -663,10 +747,11 @@ def _inner_main():
               "note": "no prior BENCH_r*.json artifact; vs_baseline "
                       "reports the native yardstick"}
         regressed = []
-        # the quality and fleet gates still apply: two artifacts can
-        # exist before any bench artifact does
+        # the quality, fleet, and dp-kernel gates still apply: two
+        # artifacts can exist before any bench artifact does
         compare_quality(line, None, vp, regressed)
         compare_fleet(line, None, vp, regressed)
+        compare_dp_kernel(line, None, vp, regressed)
         line["vs_prev"] = vp
         if regressed:
             line["regressed"] = regressed

@@ -1,15 +1,22 @@
-"""A/B harness: Pallas DP-fill kernel vs the vmapped lax.scan aligner.
+"""A/B/C promotion harness: scan vs Pallas v1 vs rotband v2 DP fill.
 
 Runs on whatever backend JAX resolves (the real chip when available:
 interpret=False on TPU).  Two parts:
 
-  1. correctness — bit-exact comparison of the kernel against the scan
-     spec at small shapes (the same checks as tests/test_banded_pallas.py,
-     but with interpret=False so the Mosaic-compiled kernel itself is
-     what runs);
-  2. throughput — both implementations timed at the bench.py shapes
-     (Z=16, P=8, W=1024 by default), reporting zmw_windows/s and DP
-     cells/s for each.
+  1. correctness — bit-exact comparison of BOTH kernels (v1 band-local
+     ops/banded_pallas.py, v2 rotating-band ops/banded_rotband.py)
+     against the scan spec at small shapes (the same checks as
+     tests/test_banded_pallas.py, but with interpret=False so the
+     Mosaic-compiled kernels themselves are what run);
+  2. throughput — all three arms timed INTERLEAVED at the bench.py
+     shapes (Z=16, P=8, W=1024 by default) under the forced-execution
+     marginal method ONLY (per-iteration block_until_ready loops are
+     rejected by construction: they read RPC latency on the lazy axon
+     runtime, the r3/r5 pollution), reporting zmw_windows/s and DP
+     cells/s for each — and a machine-readable DECISION RECORD
+     (winner, margin, backend, method) that bench.py vs_prev consumes.
+     This record is what settles ROADMAP item 1: the first run on a
+     live device backend names the production implementation.
 
 Usage:  python benchmarks/pallas_ab.py [--json out.json]
 
@@ -38,9 +45,13 @@ def _bench_args(Z, P, W, tlen, seed=0):
 
 
 def check_bit_exact(interpret: bool) -> int:
-    """Kernel vs scan at small shapes; returns number of problems checked."""
+    """Both kernels vs scan at small shapes; returns problems checked.
+
+    With interpret=False on a TPU backend this is the HARDWARE
+    bit-exactness arm for v1 and v2 alike (the v2 rotband kernel's
+    first tunnel-live proof rides this entry point)."""
     from ccsx_tpu.config import AlignParams
-    from ccsx_tpu.ops import banded, banded_pallas
+    from ccsx_tpu.ops import banded, banded_pallas, banded_rotband
     from ccsx_tpu.utils import synth
 
     rng = np.random.default_rng(7)
@@ -60,29 +71,39 @@ def check_bit_exact(interpret: bool) -> int:
     params = AlignParams()
     scan_f = banded.make_batched("global", params, with_moves=True)
     r1, m1, o1 = scan_f(qs, qlens, ts, tlens)
-    r2, m2, o2 = banded_pallas.batched_align_global_moves(
-        qs, qlens, ts, tlens, params, interpret=interpret)
-    np.testing.assert_array_equal(np.asarray(r1.score), np.asarray(r2.score))
-    np.testing.assert_array_equal(np.asarray(r1.mat), np.asarray(r2.mat))
-    np.testing.assert_array_equal(np.asarray(r1.aln), np.asarray(r2.aln))
-    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
-    m1, m2 = np.asarray(m1), np.asarray(m2)
-    for i in range(N):
-        ql = int(qlens[i])
+    m1 = np.asarray(m1)
+    for name, mod in (("pallas", banded_pallas),
+                      ("rotband", banded_rotband)):
+        r2, m2, o2 = mod.batched_align_global_moves(
+            qs, qlens, ts, tlens, params, interpret=interpret)
         np.testing.assert_array_equal(
-            m1[i, :ql], m2[i, :ql], err_msg=f"moves mismatch, problem {i}")
-    # and the slim kernel (the production consensus config)
-    r3, m3, o3 = banded_pallas.batched_align_global_moves(
-        qs, qlens, ts, tlens, params, interpret=interpret,
-        with_stats=False)
-    np.testing.assert_array_equal(np.asarray(r1.score), np.asarray(r3.score))
-    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o3))
-    m3 = np.asarray(m3)
-    for i in range(N):
-        ql = int(qlens[i])
+            np.asarray(r1.score), np.asarray(r2.score), err_msg=name)
         np.testing.assert_array_equal(
-            m1[i, :ql], m3[i, :ql],
-            err_msg=f"slim moves mismatch, problem {i}")
+            np.asarray(r1.mat), np.asarray(r2.mat), err_msg=name)
+        np.testing.assert_array_equal(
+            np.asarray(r1.aln), np.asarray(r2.aln), err_msg=name)
+        np.testing.assert_array_equal(
+            np.asarray(o1), np.asarray(o2), err_msg=name)
+        m2 = np.asarray(m2)
+        for i in range(N):
+            ql = int(qlens[i])
+            np.testing.assert_array_equal(
+                m1[i, :ql], m2[i, :ql],
+                err_msg=f"{name} moves mismatch, problem {i}")
+        # and the slim kernel (the production consensus config)
+        r3, m3, o3 = mod.batched_align_global_moves(
+            qs, qlens, ts, tlens, params, interpret=interpret,
+            with_stats=False)
+        np.testing.assert_array_equal(
+            np.asarray(r1.score), np.asarray(r3.score), err_msg=name)
+        np.testing.assert_array_equal(
+            np.asarray(o1), np.asarray(o3), err_msg=name)
+        m3 = np.asarray(m3)
+        for i in range(N):
+            ql = int(qlens[i])
+            np.testing.assert_array_equal(
+                m1[i, :ql], m3[i, :ql],
+                err_msg=f"{name} slim moves mismatch, problem {i}")
     return N
 
 
@@ -173,14 +194,17 @@ def time_fill_only(impl: str, Z, P, W, tlen, iters=300,
         from ccsx_tpu.ops import banded, banded_pallas
 
         params = AlignParams()
-        if impl == "pallas":
+        if impl in ("pallas", "rotband"):
+            from ccsx_tpu.ops import banded_rotband
+
+            mod = banded_rotband if impl == "rotband" else banded_pallas
             interp = jax.default_backend() != "tpu"
 
             @jax.jit
             def fill(qs, qlens, ts, tlens):
                 # with_stats=False: the consensus-round configuration
                 # (star._aligner) — slim carry, 1-array F scan
-                return banded_pallas.batched_align_global_moves(
+                return mod.batched_align_global_moves(
                     qs, qlens, ts, tlens, params, interpret=interp,
                     with_stats=False)
         else:
@@ -256,18 +280,19 @@ def main():
     # The chip's available throughput also drifts minute-to-minute
     # (shared/tunnelled), so scan and pallas windows are INTERLEAVED and
     # medians reported — drift hits both impls equally.
+    ARMS = ("scan", "pallas", "rotband")
     if args.mode in ("time", "both"):
         import statistics
 
-        rounds = {"scan": [], "pallas": []}
-        fills = {"scan": [], "pallas": []}
+        rounds = {impl: [] for impl in ARMS}
+        fills = {impl: [] for impl in ARMS}
         # a window where every marginal sample is nonpositive raises
         # RuntimeError (marginal_time's honest refusal) — on a noisy
         # shared chip that is one lost WINDOW, not a lost A/B: count it,
         # keep the samples already collected, and keep interleaving
         lost = []
         for rep in range(5):
-            for impl in ("scan", "pallas"):
+            for impl in ARMS:
                 try:
                     rounds[impl] += time_impl(
                         impl, args.Z, args.P, args.W, args.tlen,
@@ -284,7 +309,7 @@ def main():
             out["windows_lost"] = lost
             print(f"[pallas_ab] {len(lost)} timing window(s) lost to "
                   "nonpositive marginals (kept going)", file=sys.stderr)
-        for impl in ("scan", "pallas"):
+        for impl in ARMS:
             if rounds[impl]:
                 out[f"round_{impl}"] = statistics.median(rounds[impl])
             else:
@@ -304,38 +329,76 @@ def main():
                       f"{out[f'fill_{impl}']['dp_cells_per_sec']:.3e} "
                       "cells/s", file=sys.stderr)
 
+        # ---- the DECISION RECORD (the promotion protocol's verdict,
+        # ---- consumed by bench.py vs_prev): winner by the full-round
+        # ---- median — the metric star._aligner's dispatch actually
+        # ---- moves — with the fill-only medians carried alongside;
+        # ---- margin = winner/runner-up.  Method is marginal-fetch by
+        # ---- construction (this file has no other timing path).
+        round_rates = {impl: out.get(f"round_{impl}") for impl in ARMS
+                       if out.get(f"round_{impl}")}
+        fill_rates = {
+            impl: out[f"fill_{impl}"]["dp_cells_per_sec"]
+            for impl in ARMS if out.get(f"fill_{impl}")}
+        metric, rates = ("round_zmw_windows_per_sec", round_rates)
+        if not rates:
+            # every round window lost (degenerate chip): fall back to
+            # the fill medians rather than emitting no verdict at all
+            metric, rates = ("fill_dp_cells_per_sec", fill_rates)
+        if rates:
+            ranked = sorted(rates, key=rates.get, reverse=True)
+            winner = ranked[0]
+            margin = (rates[winner] / rates[ranked[1]]
+                      if len(ranked) > 1 else None)
+            out["decision"] = {
+                "winner": winner,
+                "margin": round(margin, 4) if margin else None,
+                "metric": metric,
+                "round_rates": round_rates,
+                "fill_rates": fill_rates,
+                "backend": backend,
+                "interpret": interpret,
+                "method": "marginal-fetch",
+            }
+            print(f"[decision] winner={winner} "
+                  f"margin={out['decision']['margin']} "
+                  f"metric={metric} backend={backend} "
+                  f"interpret={interpret}", file=sys.stderr)
+
     if args.mode in ("time", "both") and gblock_list:
         # gblock sweep, fill-only.  NB the env is read at TRACE time of
         # the cached @jax.jit fill closure in time_fill_only — it is the
         # _STEP_CACHE.pop that forces a fresh closure (fresh jit cache)
         # per value; without it every g would re-time the first kernel.
         prior = os.environ.get("CCSX_PALLAS_GBLOCK")
-        out["fill_pallas_gblock"] = {}
         try:
-            for g in gblock_list:
-                os.environ["CCSX_PALLAS_GBLOCK"] = str(g)
-                _STEP_CACHE.pop(("fill", "pallas"), None)
-                try:
-                    fr = sorted(
-                        time_fill_only("pallas", args.Z, args.P, args.W,
-                                       args.tlen, iters=50, repeats=3),
-                        key=lambda d: d["dp_cells_per_sec"])
-                except RuntimeError as e:
-                    # same lost-window policy as the interleaved A/B
-                    out["fill_pallas_gblock"][g] = None
-                    print(f"pallas gblock={g}: window lost ({e})",
-                          file=sys.stderr)
-                    continue
-                out["fill_pallas_gblock"][g] = fr[len(fr) // 2]
-                print(f"pallas gblock={g}: "
-                      f"{fr[len(fr) // 2]['dp_cells_per_sec']:.3e} cells/s",
-                      file=sys.stderr)
+            for impl in ("pallas", "rotband"):
+                out[f"fill_{impl}_gblock"] = {}
+                for g in gblock_list:
+                    os.environ["CCSX_PALLAS_GBLOCK"] = str(g)
+                    _STEP_CACHE.pop(("fill", impl), None)
+                    try:
+                        fr = sorted(
+                            time_fill_only(impl, args.Z, args.P, args.W,
+                                           args.tlen, iters=50, repeats=3),
+                            key=lambda d: d["dp_cells_per_sec"])
+                    except RuntimeError as e:
+                        # same lost-window policy as the interleaved arms
+                        out[f"fill_{impl}_gblock"][g] = None
+                        print(f"{impl} gblock={g}: window lost ({e})",
+                              file=sys.stderr)
+                        continue
+                    out[f"fill_{impl}_gblock"][g] = fr[len(fr) // 2]
+                    print(f"{impl} gblock={g}: "
+                          f"{fr[len(fr) // 2]['dp_cells_per_sec']:.3e} "
+                          "cells/s", file=sys.stderr)
         finally:
             if prior is None:
                 os.environ.pop("CCSX_PALLAS_GBLOCK", None)
             else:
                 os.environ["CCSX_PALLAS_GBLOCK"] = prior
             _STEP_CACHE.pop(("fill", "pallas"), None)
+            _STEP_CACHE.pop(("fill", "rotband"), None)
 
     if args.mode in ("check", "both"):
         n = check_bit_exact(interpret)

@@ -56,7 +56,12 @@ CCSX_PROJECTOR=scan timeout -k 30 2400 \
     python benchmarks/round_profile.py \
     --json benchmarks/round_profile_r06_scanproj.json
 
-# (4) pallas A/B with the honest harness if time remains
+# (4) DP-kernel promotion harness with the honest marginal method:
+# three interleaved arms (scan / band-local pallas v1 / rotating-band
+# rotband v2), hardware bit-exactness for BOTH kernels first, then
+# the timed run whose "decision" record (winner, margin, backend,
+# method) is what bench.py's vs_prev dp-kernel leg gates and what the
+# promotion protocol in consensus/star.py acts on
 timeout -k 30 1200 python benchmarks/pallas_ab.py --mode check
 timeout -k 30 2400 python benchmarks/pallas_ab.py --mode time \
-    --gblocks 8,16,32 --json benchmarks/pallas_ab_tpu_r06.json
+    --gblocks 8,16,32 --json benchmarks/pallas_ab_tpu_r07.json

@@ -112,6 +112,13 @@ TPU extensions (long options):
                            device compute; 0 = inline prep on the
                            driver thread, the old behavior; output
                            bytes identical either way) [auto]
+--banded-impl {scan,pallas,rotband}
+                          (banded DP-fill implementation: the lax.scan
+                           spec, the v1 band-local Pallas kernel, or
+                           the v2 rotating-band kernel — all three
+                           bit-identical (the A/B knob the promotion
+                           harness benchmarks/pallas_ab.py drives);
+                           also settable as CCSX_BANDED_IMPL) [scan]
 --prefilter {on,off}      (device pre-alignment screen: one batched
                            dispatch scores each wave of strand_match
                            pair candidates and rejects hopeless ones
@@ -242,6 +249,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "compute; 0 = inline prep (the old behavior). "
                         "Output bytes are identical either way "
                         "[auto-size to the host]")
+    p.add_argument("--banded-impl", default="", dest="banded_impl",
+                   choices=["", "scan", "pallas", "rotband"],
+                   help="banded DP-fill implementation (consensus/"
+                        "star.banded_impl): 'scan' = the lax.scan spec "
+                        "(default), 'pallas' = the v1 band-local "
+                        "kernel, 'rotband' = the v2 rotating-band "
+                        "kernel.  Bit-identical output either way "
+                        "(pinned); a pure performance A/B knob.  Also "
+                        "settable as CCSX_BANDED_IMPL [scan]")
     p.add_argument("--prefilter", default="on", choices=["on", "off"],
                    dest="prefilter",
                    help="device pre-alignment screen (ops/sketch.py): "
@@ -512,6 +528,14 @@ def config_from_args(args) -> CcsConfig:
                   ">= 0 or a fraction in (0, 1), got "
                   f"{args.max_failed_holes!r}", file=sys.stderr)
             raise SystemExit(1)
+    banded_impl = getattr(args, "banded_impl", "") or ""
+    if banded_impl:
+        import os
+
+        # dispatch reads the env (consensus/star.banded_impl) so the
+        # knob reaches every jitted aligner without threading the config
+        # through; an explicit flag wins over an inherited env var
+        os.environ["CCSX_BANDED_IMPL"] = banded_impl
     max_record_bytes = getattr(args, "max_record_bytes", None)
     if max_record_bytes is not None and max_record_bytes < 4096:
         # a bound below any real record would reject every input; 4096
@@ -548,6 +572,7 @@ def config_from_args(args) -> CcsConfig:
         max_failed_holes=max_failed,
         salvage=bool(getattr(args, "salvage", False)),
         prefilter=getattr(args, "prefilter", "on") != "off",
+        banded_impl=banded_impl,
         **({"seed_device_min_t": seed_device_min_t}
            if seed_device_min_t is not None else {}),
         **({"max_record_bytes": max_record_bytes}

@@ -20,7 +20,7 @@ import jax
 import numpy as np
 
 from ccsx_tpu.config import AlignParams
-from ccsx_tpu.ops import banded, banded_pallas, msa, traceback
+from ccsx_tpu.ops import banded, banded_pallas, banded_rotband, msa, traceback
 
 
 def pass_bucket(n: int, buckets: Sequence[int]) -> int:
@@ -64,9 +64,9 @@ _FORCE_SCAN = False
 
 def force_scan_fallback(reason: str) -> bool:
     """Pin the banded fill to the lax.scan spec for the rest of this
-    process (overriding CCSX_BANDED_IMPL=pallas).  Returns True the
-    first time — the caller should retry its dispatch — and False if the
-    scan was already forced (the failure is not the kernel's)."""
+    process (overriding CCSX_BANDED_IMPL=pallas/rotband).  Returns True
+    the first time — the caller should retry its dispatch — and False if
+    the scan was already forced (the failure is not the kernel's)."""
     global _FORCE_SCAN
     if _FORCE_SCAN:
         return False
@@ -79,31 +79,60 @@ def force_scan_fallback(reason: str) -> bool:
     return True
 
 
-def use_pallas() -> bool:
-    """Banded DP-fill implementation choice; CCSX_BANDED_IMPL overrides
-    ({pallas, scan}), and a compile-failure fallback
-    (force_scan_fallback) overrides both.  The scan implementation is the spec — the G-batched
-    kernel (ops/banded_pallas.py) is differential-tested bit-exact against
-    it, on real TPU hardware with interpret=False (benchmarks/pallas_ab.py
-    --mode check, 2026-07-29, v5e) as well as in interpret mode
-    (tests/test_banded_pallas.py).
+def banded_impl() -> str:
+    """Banded DP-fill implementation choice: 'scan' (the lax.scan spec,
+    default), 'pallas' (the v1 band-local G-batched kernel,
+    ops/banded_pallas.py) or 'rotband' (the v2 rotating-band kernel,
+    ops/banded_rotband.py).  CCSX_BANDED_IMPL selects; the
+    compile-failure fallback (force_scan_fallback) overrides everything.
+    All three are bit-identical in global+moves mode — the scan is the
+    spec, both kernels are differential-tested against it
+    (tests/test_banded_pallas.py three-way fuzz, interpret mode on CPU;
+    the v1 kernel additionally proven on real v5e 2026-07-29 with
+    interpret=False) — so the knob is non-semantic
+    (fingerprint._NON_SEMANTIC) and free to A/B.
 
-    Default is the vmapped scan on every backend.  Measured 2026-07-29 on
-    v5e (benchmarks/pallas_ab_tpu.json, interleaved medians at the bench
-    shapes Z=16 P=8 W=1024): scan round 183k zmw-windows/s vs pallas round
-    142k; DP-fill-only 5.9e10 vs 3.3e10 cells/s — XLA's compilation of the
-    scan, which vectorizes the Z*P alignment batch across lanes AND
-    pipelines rows, still beats the G=8-sublane-batched kernel ~1.3x on
-    the full round.  The kernel stays available for A/B runs
-    (CCSX_BANDED_IMPL=pallas) and as the fallback position if XLA's scan
-    lowering regresses."""
+    PROMOTION PROTOCOL (r14, supersedes the r5 timing discussion that
+    used to live here): every pre-r14 hardware timing — scan ahead of
+    the kernel in all of them — was taken with per-iteration
+    block_until_ready loops, which the lazy axon runtime turns into
+    RPC-latency readings (bench.py docstring); they order the arms
+    consistently but none is a chip time.  The decision now rests on
+    benchmarks/pallas_ab.py, which times all three arms under the
+    forced-execution marginal method only and emits a machine-readable
+    decision record (winner, margin, backend, method) that bench.py
+    vs_prev gates.  The scan stays the default until a decision record
+    from a real device backend names a kernel the winner; the rotband
+    kernel is the structural attack on why v1 lost (the ~24-op per-row
+    select chain is replaced by residue-lane masks, ~60 -> ~45 tile
+    ops/row — audit in the banded_rotband.py docstring).  Per-dispatch
+    attribution is visible as the ccsx_banded_impl counter in /metrics
+    and the :b<impl> trace-group suffix."""
     if _FORCE_SCAN:
-        return False
+        return "scan"
     impl = os.environ.get("CCSX_BANDED_IMPL", "")
-    if impl not in ("", "pallas", "scan"):
+    if impl not in ("", "scan", "pallas", "rotband"):
         raise ValueError(
-            f"CCSX_BANDED_IMPL={impl!r}: expected 'pallas' or 'scan'")
-    return impl == "pallas"
+            f"CCSX_BANDED_IMPL={impl!r}: expected 'scan', 'pallas' or "
+            "'rotband'")
+    return impl or "scan"
+
+
+def banded_impl_effective(qmax: int) -> str:
+    """The implementation _aligner actually dispatches at this qmax: the
+    kernels gate on the qmax cap and row-block alignment and fall back
+    to the scan spec (same guard for v1 and v2)."""
+    impl = banded_impl()
+    if impl != "scan" and (qmax > banded_pallas.PALLAS_MAX_QMAX
+                           or qmax % banded_pallas.ROWBLOCK != 0):
+        return "scan"
+    return impl
+
+
+def use_pallas() -> bool:
+    """True iff a Pallas kernel (v1 or v2) is selected — kept for the
+    profiler/battery reports; dispatch goes through banded_impl()."""
+    return banded_impl() != "scan"
 
 
 @functools.lru_cache(maxsize=8)
@@ -118,15 +147,14 @@ def _aligner(params: AlignParams):
                                  with_stats=False)
 
     def f(qs, qlens, ts, tlens):
-        qmax = qs.shape[-1]
-        if (not use_pallas()
-                or qmax > banded_pallas.PALLAS_MAX_QMAX
-                or qmax % banded_pallas.ROWBLOCK != 0):
+        impl = banded_impl_effective(qs.shape[-1])
+        if impl == "scan":
             return scan_f(qs, qlens, ts, tlens)
-        # with_stats=False for the kernel too: the rounds read only
-        # (moves, offs), and the slim carry (3 rows vs 7, 1-array F scan
-        # vs 3) cuts most of the kernel's per-cell op count
-        return banded_pallas.batched_align_global_moves(
+        # with_stats=False for the kernels too: the rounds read only
+        # (moves, offs), and the slim carry (3 rows vs 7 / 2 vs 6, a
+        # 1-array F scan vs 3) cuts most of the per-cell op count
+        mod = banded_rotband if impl == "rotband" else banded_pallas
+        return mod.batched_align_global_moves(
             qs, qlens, ts, tlens, params, with_stats=False,
             interpret=jax.default_backend() != "tpu")
 

@@ -37,38 +37,51 @@ windowed consensus path (the default) always fits.  Callers use
 ops/banded.select_aligner-style dispatch in consensus/star.py.
 
 Per-cell cost analysis (r5, after the slim with_stats=False carry):
-the remaining per-row tile-op budget splits ~24 ops select chain
-(diag/vert views of the H/E carry at per-problem shift d), ~21 ops
-F prefix scan (7 Hillis-Steele steps x roll+cmp+select), ~15 ops
-recurrence+moves.  The select chain is irreducible in this band-local
-lane layout: d differs per problem inside a G-block, so a scalar
-dynamic rotate cannot replace the per-candidate static shifts, and
-pre-shifting the carry at row end just moves the same chain.  The one
-known structural attack is a rotating-band layout (lane k holds
-column j === k mod B): vertical/diag predecessors become mask+static-
-rotate (~11 ops, no chain), but the F scan then needs per-step
-wrap masks (+14 ops) and the moves come out lane-rotated (one
-post-pass or projector index change) — net ~15% estimated, with real
-lowering risk.  Decision: hold that redesign until the slim kernel is
-timed on hardware (benchmarks/pallas_ab.py); if XLA's scan still wins
-after slim, the scan is the design and this kernel stays as the
-documented experiment (VERDICT r4 weak 3 protocol).
+the per-row tile-op budget of THIS (v1, band-local) layout splits
+~24 ops select chain (diag/vert views of the H/E carry at
+per-problem shift d), ~21 ops F prefix scan (7 Hillis-Steele steps x
+roll+cmp+select), ~15 ops recurrence+moves, ~60 total.  The select
+chain is irreducible in the band-local lane layout: d differs per
+problem inside a G-block, so a scalar dynamic rotate cannot replace
+the per-candidate static shifts, and pre-shifting the carry at row
+end just moves the same chain.
 
-HARDWARE STATUS (v5e, 2026-07-31): bit-exactness PROVEN on the real
-chip — 8/8 problems identical to the scan spec (`pallas_ab.py --mode
-check`, a fetch-synced comparison, immune to the timing caveat below).
-The r5 first-cut timing (pallas_ab_tpu_r05.json: scan 5.70e10 vs slim
-4.88e10 cells/s, rounds 90.8k vs 86.3k; gblocks 8/16/32 →
-4.57/4.72/3.67e10) and the r3 numbers were all taken with
-per-iteration block_until_ready loops, which the lazy axon runtime
-turns into RPC-latency readings (bench.py docstring has the
-discovery) — they consistently ORDER scan ahead of the kernel but none
-is a chip time.  pallas_ab.py now times with the forced-execution
-marginal method; its next hardware run decides whether the scan is
-promoted to "the design" or the kernel closes the gap.  Until a
-measurement favors the kernel, the scan stays the default: it is the
-spec, and every reading so far — however latency-polluted — has the
-same sign.
+The structural attack — a rotating-band layout where lane k holds
+column j ≡ k mod B, so the chain becomes one per-problem mask +
+static-rotate pair (~11 ops) — is IMPLEMENTED as of r14 in the
+sibling ops/banded_rotband.py (v2).  Two estimates in the r5
+paragraph above turned out wrong in v2's favor: the F scan needs NO
+extra per-step cost (the wrap mask substitutes ``krel`` for the
+column index one-for-one, ~21 ops unchanged), and the lane-rotated
+moves are restored by a single host-side take_along_axis gather
+outside the kernel, not an in-kernel post-pass.  v2's audited budget
+is ~45 ops/row vs ~60 here; the full derivation and the audit table
+live in banded_rotband.py's docstring.
+
+This v1 kernel stays as the band-local reference point of the
+promotion protocol: benchmarks/pallas_ab.py times all three arms
+(scan / v1 / v2 rotband) with the forced-execution marginal method
+and emits a machine-readable decision record {winner, margin,
+backend, method} that bench.py's vs_prev dp-kernel leg gates.  The
+scan in ops/banded.py remains the spec and the differential oracle
+for BOTH kernels; promotion (flipping the CCSX_BANDED_IMPL default
+in consensus/star.py) happens only on a hardware decision record
+that names a kernel the winner.
+
+HARDWARE STATUS (v5e, 2026-07-31; pre-rotband): bit-exactness of v1
+PROVEN on the real chip — 8/8 problems identical to the scan spec
+(`pallas_ab.py --mode check`, a fetch-synced comparison).  All
+timing taken before the marginal-fetch method landed
+(pallas_ab_tpu_r05.json and earlier) was per-iteration
+block_until_ready, which the lazy axon runtime turns into
+RPC-latency readings (bench.py docstring has the discovery) — it
+consistently ORDERED scan ahead of v1 but none of it is a chip
+time.  The rotband v2 arm has bit-exactness proven in interpret
+mode and compiles with interpret=False; its first hardware decision
+record (tpu_battery.sh step 4, pallas_ab_tpu_r07.json) is the next
+promotion input.  Until a hardware record names a kernel the
+winner, the scan stays the default: it is the spec, and every
+reading so far — however latency-polluted — has the same sign.
 """
 
 from __future__ import annotations
@@ -83,6 +96,7 @@ from jax.experimental.pallas import tpu as pltpu
 from ccsx_tpu.config import AlignParams
 from ccsx_tpu.ops.banded import (
     BandedResult, EBIT_EXT, FBIT_EXT, MOVE_DIAG, MOVE_LEFT, MOVE_UP, NEG, PAD,
+    _line_interp,
 )
 
 PALLAS_MAX_QMAX = 4096  # beyond this fall back to the scan implementation
@@ -106,7 +120,11 @@ def compute_offsets(qlen, tlen, qmax: int, band: int, maxshift: int,
         li0, lj0, li1, lj1 = line[0], line[1], line[2], line[3]
 
     def body(off_prev, i):
-        nom_j = lj0 + ((i - li0) * (lj1 - lj0)) // jnp.maximum(li1 - li0, 1)
+        # overflow-exact interpolation SHARED with the scan body (the raw
+        # int32 product silently diverged from ops/banded.py for large
+        # seeded lines — the pre-r14 drift; one definition, imported)
+        nom_j = lj0 + _line_interp(i - li0, lj1 - lj0,
+                                   jnp.maximum(li1 - li0, 1))
         desired = nom_j - band // 2
         lo = jnp.maximum(0, tcap - (qlen - i) * maxshift)
         off = jnp.clip(

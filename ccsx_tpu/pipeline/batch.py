@@ -50,7 +50,7 @@ from ccsx_tpu.consensus.align_host import MatchResult
 from ccsx_tpu.consensus.hole import full_gen_for_zmw
 from ccsx_tpu.consensus.star import (
     RefineRequest, RefineResult, RoundRequest, RoundResult, StarMsa,
-    bucket_len, pad_to, refine_host,
+    banded_impl_effective, bucket_len, pad_to, refine_host,
 )
 from ccsx_tpu.ops import banded
 from ccsx_tpu.ops import encode as enc
@@ -1845,7 +1845,11 @@ class BatchExecutor:
         cfg = self.cfg
         Lbig, Lsmall = _slab_wire_sizes(R, qmax, H, tmax,
                                         cfg.max_ins_per_col)
-        group = f"packed:q{qmax}:t{tmax}:i{iters}"
+        # same :b<impl> suffix as the real dispatch's span — the warmup
+        # compile and the first execute must book under ONE group key or
+        # the compile-storm accounting splits across two rows
+        group = (f"packed:q{qmax}:t{tmax}:i{iters}"
+                 f":b{banded_impl_effective(qmax)}")
         if dstack > 1:
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as PS
@@ -1970,8 +1974,14 @@ class BatchExecutor:
             args = self._stack_group(requests, idxs, P, qmax, tmax)
             faultinject.fire("device_oom")
             Z = self._round_z(len(idxs))
+            # :b<impl> suffix + labeled counter: per-implementation
+            # dispatch attribution (scan / pallas / rotband), resolved
+            # at dispatch time so a compile-forced scan pin shows up
+            bimpl = banded_impl_effective(qmax)
+            if self.metrics is not None:
+                self.metrics.bump_banded(bimpl)
             with trace.device_span(
-                    "round", group=f"round:P{P}:q{qmax}:t{tmax}",
+                    "round", group=f"round:P{P}:q{qmax}:t{tmax}:b{bimpl}",
                     cells=Z * P * qmax * cfg.align.band,
                     shape=f"Z{Z}", n=len(idxs), Z=Z) as sp:
                 faultinject.fire("stall")
@@ -2011,8 +2021,10 @@ class BatchExecutor:
         for (P, qmax, tmax), idxs in groups.items():
             self._count_cells(requests, idxs, P, qmax,
                               self._round_z(len(idxs)))
-        self._run_groups(groups, dispatch, finish, host_one, results,
-                         label=lambda k: f"round:P{k[0]}:q{k[1]}:t{k[2]}")
+        self._run_groups(
+            groups, dispatch, finish, host_one, results,
+            label=lambda k: (f"round:P{k[0]}:q{k[1]}:t{k[2]}"
+                             f":b{banded_impl_effective(k[1])}"))
         return results
 
     def _run_refine(self, requests: List[RefineRequest]) -> List[RefineResult]:
@@ -2040,9 +2052,12 @@ class BatchExecutor:
             args = self._stack_group(requests, idxs, P, qmax, tmax)
             faultinject.fire("device_oom")
             Z = self._round_z(len(idxs))
+            bimpl = banded_impl_effective(qmax)
+            if self.metrics is not None:
+                self.metrics.bump_banded(bimpl)
             with trace.device_span(
                     "refine",
-                    group=f"refine:P{P}:q{qmax}:t{tmax}:i{iters}",
+                    group=f"refine:P{P}:q{qmax}:t{tmax}:i{iters}:b{bimpl}",
                     cells=Z * P * qmax * cfg.align.band * iters,
                     shape=f"Z{Z}", n=len(idxs), Z=Z) as sp:
                 faultinject.fire("stall")
@@ -2092,8 +2107,10 @@ class BatchExecutor:
         for (P, qmax, tmax, iters), idxs in groups.items():
             self._count_cells(requests, idxs, P, qmax,
                               self._round_z(len(idxs)), iters)
-        self._run_groups(groups, dispatch, finish, host_one, results,
-                         label=lambda k: f"refine:P{k[0]}:q{k[1]}:t{k[2]}:i{k[3]}")
+        self._run_groups(
+            groups, dispatch, finish, host_one, results,
+            label=lambda k: (f"refine:P{k[0]}:q{k[1]}:t{k[2]}:i{k[3]}"
+                             f":b{banded_impl_effective(k[1])}"))
         return results
 
     def _run_refine_packed(
@@ -2198,6 +2215,9 @@ class BatchExecutor:
             qmax, tmax, iters, _ = key
             faultinject.fire("device_oom")
             band = cfg.align.band
+            bimpl = banded_impl_effective(qmax)
+            if self.metrics is not None:
+                self.metrics.bump_banded(bimpl)
             if not fused:
                 args = self._stack_slab(requests, idxs, qmax, tmax)
                 R = args[0].shape[0]
@@ -2210,7 +2230,7 @@ class BatchExecutor:
                     self._bp_consts(), pack=(R, qmax))
                 with trace.device_span(
                         "refine_packed",
-                        group=f"packed:q{qmax}:t{tmax}:i{iters}",
+                        group=f"packed:q{qmax}:t{tmax}:i{iters}:b{bimpl}",
                         cells=R * qmax * band * iters,
                         shape=f"R{R}:S{H}",
                         plan={"slab": key[3], "rows": R,
@@ -2245,7 +2265,7 @@ class BatchExecutor:
             sharding = NamedSharding(self._slab_mesh, PS("slab", None))
             with trace.device_span(
                     "refine_packed",
-                    group=f"packed:q{qmax}:t{tmax}:i{iters}",
+                    group=f"packed:q{qmax}:t{tmax}:i{iters}:b{bimpl}",
                     cells=len(plan) * R * qmax * band * iters,
                     shape=f"D{K * D}:R{R}:S{H}",
                     plan={"wave": key[3], "slabs": len(plan),
@@ -2300,8 +2320,10 @@ class BatchExecutor:
                 _finish_slab([idxs[j] for j in s], tmax,
                              big[d], small[d], R, H)
 
-        self._run_groups(groups, dispatch, finish, host_one, results,
-                         label=lambda k: f"packed:q{k[0]}:t{k[1]}:i{k[2]}")
+        self._run_groups(
+            groups, dispatch, finish, host_one, results,
+            label=lambda k: (f"packed:q{k[0]}:t{k[1]}:i{k[2]}"
+                             f":b{banded_impl_effective(k[0])}"))
         return results
 
 

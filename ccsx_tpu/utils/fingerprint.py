@@ -65,6 +65,12 @@ _NON_SEMANTIC = frozenset({
     # output bytes (pinned by the scale-config md5 across prefilter
     # on/off and both crossover settings)
     "prefilter", "seed_device_min_t",
+    # banded DP-fill backend (consensus/star.banded_impl): scan, pallas
+    # and rotband are pinned bit-identical by the three-way differential
+    # suite and the scale-config md5 across all three values, so the
+    # knob (and the canonical A/B move "re-run WITH --banded-impl X and
+    # resume") can never change output bytes
+    "banded_impl",
 })
 
 

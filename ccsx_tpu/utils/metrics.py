@@ -144,6 +144,12 @@ class Metrics:
     pairs_seeded_device: int = 0
     pairs_seeded_host: int = 0
     device_dispatches: int = 0
+    # per-implementation banded DP-fill attribution (consensus/star.
+    # banded_impl dispatch): {"scan"|"pallas"|"rotband": dispatches}.
+    # Makes an A/B run or a breaker/compile-forced scan pin visible in
+    # top/stats//metrics (ccsx_banded_impl{impl=...}) without logs —
+    # bumped at the round/refine/packed dispatch sites via bump_banded()
+    banded_dispatches: dict = dataclasses.field(default_factory=dict)
     refine_overflows: int = 0  # fused windows replayed on host (rare)
     # fault-tolerance ladder counters (pipeline/batch.py recovery):
     # group bisections after a device OOM, per-request host replays
@@ -322,6 +328,13 @@ class Metrics:
         with self._count_lock:
             for k, v in deltas.items():
                 setattr(self, k, getattr(self, k) + v)
+
+    def bump_banded(self, impl: str, n: int = 1) -> None:
+        """Attribute n banded DP-fill dispatches to an implementation
+        (thread-safe; dispatch closures run on executor threads)."""
+        with self._count_lock:
+            self.banded_dispatches[impl] = (
+                self.banded_dispatches.get(impl, 0) + n)
 
     def add_stage(self, stage: str, seconds: float) -> None:
         """Thread-safe accumulation into t_<stage>."""
@@ -521,6 +534,8 @@ class Metrics:
             snap["filtered_reasons"] = dict(self.filtered_reasons)
         if self.corrupt_reasons:
             snap["corrupt_reasons"] = dict(self.corrupt_reasons)
+        if self.banded_dispatches:
+            snap["banded_dispatches"] = dict(self.banded_dispatches)
         if self.breaker_strike_log:
             # list() copy: the breaker publishes a fresh list per
             # strike, but a scraper could catch the reassignment

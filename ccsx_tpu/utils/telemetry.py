@@ -93,6 +93,9 @@ PROM_GAUGES = (
 # snapshot keys with dedicated (non-scalar) renderings
 PROM_STRUCTURED = ("groups", "groups_forced", "degraded", "progress",
                    "filtered_reasons", "corrupt_reasons",
+                   # per-implementation banded DP-fill attribution
+                   # (ccsx_banded_impl{impl=...}): scan/pallas/rotband
+                   "banded_dispatches",
                    "breaker_state", "breaker_strike_log",
                    # failed native .so auto-rebuild (string detail;
                    # rendered as a 0/1 gauge like degraded)
@@ -169,6 +172,11 @@ def render_prometheus(snap: dict, gauges: Optional[dict] = None) -> str:
     for reason, n in sorted((snap.get("corrupt_reasons") or {}).items()):
         sample("corrupt_reason", n, "counter",
                labels=f'{{reason="{_prom_escape(reason)}"}}')
+    # banded DP-fill dispatches by implementation (consensus/star.
+    # banded_impl three-way: scan / pallas / rotband)
+    for impl, n in sorted((snap.get("banded_dispatches") or {}).items()):
+        sample("banded_impl", n, "counter",
+               labels=f'{{impl="{_prom_escape(impl)}"}}')
     for gkey, st in sorted((snap.get("groups") or {}).items()):
         labels = f'{{group="{_prom_escape(gkey)}"}}'
         for f in GROUP_FIELDS:

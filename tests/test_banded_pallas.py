@@ -11,13 +11,16 @@ kernel runs Mosaic-compiled (interpret=False) on the chip — last done
 2026-07-29 on v5e, all green.
 """
 
+import os
+
 import numpy as np
 import pytest
 
 import jax
+import jax.numpy as jnp
 
 from ccsx_tpu.config import AlignParams
-from ccsx_tpu.ops import banded, banded_pallas
+from ccsx_tpu.ops import banded, banded_pallas, banded_rotband
 from ccsx_tpu.utils import synth
 
 # interpret only off-TPU: Mosaic-compile the kernel when the chip is real
@@ -172,3 +175,251 @@ def test_qmax_cap():
             np.zeros((1, 128), np.uint8),
             np.zeros(1, np.int32),
             AlignParams(), interpret=INTERPRET)
+
+
+# ---- offset-schedule differentials (r14) -----------------------------------
+# compute_offsets is shared by BOTH kernels and fed to the traceback, so a
+# silent divergence from the scan's in-body recurrence mis-bands every
+# kernel alignment at once.  The r14 bugfix replaced its raw int32
+# interpolation product with the shared _line_interp (the raw product
+# overflowed for large seeded lines); these tests pin the schedule against
+# (1) a pure-Python big-int oracle at coordinates whose product crosses
+# 2**31 and (2) the scan's own emitted offsets under seeded lines.
+
+
+def _offsets_oracle(qlen, tlen, qmax, band, maxshift, line):
+    """The scan's offset recurrence in pure Python (unbounded ints) —
+    overflow-free by construction, floor division exact on negatives
+    (Python // == the mathematical floor _line_interp implements)."""
+    li0, lj0, li1, lj1 = line
+    tcap = max(tlen - band + 1, 0)
+    denom = max(li1 - li0, 1)
+    off_prev, out = 0, []
+    for i in range(1, qmax + 1):
+        nom_j = lj0 + ((i - li0) * (lj1 - lj0)) // denom
+        desired = nom_j - band // 2
+        lo = max(0, tcap - (qlen - i) * maxshift)
+        off = min(max(max(desired, lo), off_prev),
+                  min(off_prev + maxshift, tcap))
+        off = max(off, off_prev)
+        if i > qlen:
+            off = off_prev
+        out.append(off)
+        off_prev = off
+    return out
+
+
+def test_compute_offsets_matches_bigint_oracle_large_coords():
+    """Seeded lines (and the default global line) at template coordinates
+    where the interpolation product (i-li0)*(lj1-lj0) exceeds int32 —
+    the exact regime where the pre-r14 raw product silently wrapped."""
+    rng = np.random.default_rng(29)
+    qmax, band, maxshift = 256, 128, 4
+    for rep in range(6):
+        qlen = int(rng.integers(64, qmax + 1))
+        tlen = int(rng.integers(2**24, 2**25))
+        if rep % 2 == 0:
+            line = (0, 0, qlen, tlen)  # the default global line
+            arg = None
+        else:
+            lj0 = int(rng.integers(0, 2**20))
+            lj1 = int(rng.integers(lj0 + 2**24, tlen))
+            line = (0, lj0, qlen, lj1)
+            arg = np.array(line, np.int32)
+        assert (qmax - line[0]) * (line[3] - line[1]) > 2**31
+        got = np.asarray(banded_pallas.compute_offsets(
+            jnp.int32(qlen), jnp.int32(tlen), qmax, band, maxshift,
+            line=arg))
+        want = _offsets_oracle(qlen, tlen, qmax, band, maxshift, line)
+        np.testing.assert_array_equal(
+            got, np.array(want, np.int32),
+            err_msg=f"rep {rep}: qlen={qlen} tlen={tlen} line={line}")
+
+
+def test_compute_offsets_matches_scan_schedule_seeded_lines():
+    """compute_offsets == the offsets the scan itself emits, under random
+    seeded lines — the kernels' schedule and the spec's must be the SAME
+    array or the traceback walks a different band than the fill wrote."""
+    rng = np.random.default_rng(31)
+    Qmax, Tmax, N = 128, 2048, 6
+    params = AlignParams()
+    qs = np.full((N, Qmax), banded.PAD, np.uint8)
+    ts = np.full((N, Tmax), banded.PAD, np.uint8)
+    qlens = np.zeros(N, np.int32)
+    tlens = np.zeros(N, np.int32)
+    lines = np.zeros((N, 4), np.int32)
+    for i in range(N):
+        tl = int(rng.integers(600, Tmax))
+        ql = int(rng.integers(40, Qmax + 1))
+        tb = int(rng.integers(0, tl - 300))
+        te = int(rng.integers(tb + 200, tl + 1))
+        ts[i, :tl] = rng.integers(0, 4, tl)
+        qs[i, :ql] = rng.integers(0, 4, ql)
+        qlens[i], tlens[i] = ql, tl
+        lines[i] = (0, tb, ql, te)
+    scan_f = banded.make_batched("global", params, with_moves=True,
+                                 with_line=True)
+    _, _, offs_scan = scan_f(qs, qlens, ts, tlens, lines)
+    offs_cmp = jax.vmap(
+        lambda ql, tl, ln: banded_pallas.compute_offsets(
+            ql, tl, Qmax, params.band, 4, line=ln)
+    )(jnp.asarray(qlens), jnp.asarray(tlens), jnp.asarray(lines))
+    np.testing.assert_array_equal(np.asarray(offs_scan),
+                                  np.asarray(offs_cmp))
+
+
+# ---- rotband v2 differentials (r14) ----------------------------------------
+
+
+def _compare3(qs, qlens, ts, tlens, params, with_stats=True):
+    """All three impls on the same batch: the scan is the oracle, both
+    kernels must match it bit-for-bit (scores, stats, offsets, and every
+    live move row)."""
+    scan_f = banded.make_batched("global", params, with_moves=True,
+                                 with_stats=with_stats)
+    r0, m0, o0 = scan_f(qs, qlens, ts, tlens)
+    m0 = np.asarray(m0)
+    for name, mod in (("pallas", banded_pallas), ("rotband", banded_rotband)):
+        r, m, o = mod.batched_align_global_moves(
+            qs, qlens, ts, tlens, params, interpret=INTERPRET,
+            with_stats=with_stats)
+        np.testing.assert_array_equal(
+            np.asarray(r0.score), np.asarray(r.score),
+            err_msg=f"{name}: score")
+        if with_stats:
+            np.testing.assert_array_equal(
+                np.asarray(r0.mat), np.asarray(r.mat),
+                err_msg=f"{name}: mat")
+            np.testing.assert_array_equal(
+                np.asarray(r0.aln), np.asarray(r.aln),
+                err_msg=f"{name}: aln")
+        np.testing.assert_array_equal(
+            np.asarray(o0), np.asarray(o), err_msg=f"{name}: offs")
+        m = np.asarray(m)
+        for i in range(len(qlens)):
+            ql = int(qlens[i])
+            np.testing.assert_array_equal(
+                m0[i, :ql], m[i, :ql],
+                err_msg=f"{name}: moves mismatch, problem {i}")
+
+
+def test_rotband_three_way_bit_exact():
+    """The tier-1 slice of the three-way fuzz: scan vs Pallas v1 vs
+    rotband v2 on a small random batch, full-stats mode (the slim mode
+    rides test_rotband_slim_and_gblock; the heavy shape/edge sweep is
+    the slow sibling below)."""
+    rng = np.random.default_rng(37)
+    Qmax, Tmax, N = 128, 128, 4
+    cases = [_random_case(rng, Qmax, Tmax, tmin=40, tspan=60)
+             for _ in range(N)]
+    qs = np.stack([c[0] for c in cases])
+    qlens = np.array([c[1] for c in cases], np.int32)
+    ts = np.stack([c[2] for c in cases])
+    tlens = np.array([c[3] for c in cases], np.int32)
+    _compare3(qs, qlens, ts, tlens, AlignParams())
+
+
+def test_rotband_slim_and_gblock():
+    """rotband in the consensus-round config (with_stats=False — the
+    arm star._aligner actually dispatches) must match the scan's slim
+    mode, and a non-default gblock must not change a byte of it."""
+    rng = np.random.default_rng(41)
+    Qmax, Tmax, N = 128, 128, 10   # N % 8 != 0 to exercise G padding
+    cases = [_random_case(rng, Qmax, Tmax, tmin=40, tspan=60)
+             for _ in range(N)]
+    qs = np.stack([c[0] for c in cases])
+    qlens = np.array([c[1] for c in cases], np.int32)
+    ts = np.stack([c[2] for c in cases])
+    tlens = np.array([c[3] for c in cases], np.int32)
+    scan_f = banded.make_batched("global", AlignParams(), with_moves=True,
+                                 with_stats=False)
+    r0, m0, o0 = scan_f(qs, qlens, ts, tlens)
+    r1, m1, o1 = banded_rotband.batched_align_global_moves(
+        qs, qlens, ts, tlens, AlignParams(), interpret=INTERPRET,
+        with_stats=False)
+    assert not np.asarray(r1.mat).any() and not np.asarray(r1.aln).any()
+    np.testing.assert_array_equal(np.asarray(r0.score), np.asarray(r1.score))
+    np.testing.assert_array_equal(np.asarray(o0), np.asarray(o1))
+    r2, m2, o2 = banded_rotband.batched_align_global_moves(
+        qs, qlens, ts, tlens, AlignParams(), interpret=INTERPRET,
+        with_stats=False, gblock=16)
+    np.testing.assert_array_equal(np.asarray(r1.score), np.asarray(r2.score))
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    m0, m1, m2 = np.asarray(m0), np.asarray(m1), np.asarray(m2)
+    for i in range(N):
+        ql = int(qlens[i])
+        np.testing.assert_array_equal(
+            m0[i, :ql], m1[i, :ql], err_msg=f"slim moves, problem {i}")
+        np.testing.assert_array_equal(
+            m1[i, :ql], m2[i, :ql], err_msg=f"gblock moves, problem {i}")
+
+
+def test_rotband_guards():
+    """rotband's residue arithmetic needs a power-of-two band (the & mask
+    IS the layout); the qmax cap matches v1's."""
+    with pytest.raises(ValueError):
+        banded_rotband.batched_align_global_moves(
+            np.zeros((1, 128), np.uint8), np.zeros(1, np.int32),
+            np.zeros((1, 128), np.uint8), np.zeros(1, np.int32),
+            AlignParams(), band=96, interpret=INTERPRET)
+    with pytest.raises(ValueError):
+        banded_rotband.batched_align_global_moves(
+            np.zeros((1, banded_pallas.PALLAS_MAX_QMAX + 8), np.uint8),
+            np.zeros(1, np.int32),
+            np.zeros((1, 128), np.uint8), np.zeros(1, np.int32),
+            AlignParams(), interpret=INTERPRET)
+
+
+@pytest.mark.slow  # ~1-2 min: interpret-mode kernels at an extra shape x
+# stats sweep; the fast slices above keep the tier-1 pin (r14 audit)
+def test_rotband_three_way_edge_sweep():
+    """The full three-way adversarial sweep: 256-wide shapes, padding
+    rows (qlen=0), tiny queries, qlen == Qmax, both stats modes."""
+    rng = np.random.default_rng(43)
+    Qmax, Tmax = 256, 256
+    tl = 200
+    tpl = rng.integers(0, 4, tl).astype(np.uint8)
+    ts_row = np.full(Tmax, banded.PAD, np.uint8)
+    ts_row[:tl] = tpl
+    qs = np.full((4, Qmax), banded.PAD, np.uint8)
+    qlens = np.zeros(4, np.int32)
+    # row 0: empty (padding row); row 1: tiny; row 2: qlen == Qmax;
+    # row 3: ordinary mutated read
+    qs[1, :5] = tpl[:5]
+    qlens[1] = 5
+    full = synth.mutate(rng, tpl, 0.02, 0.3, 0.02)
+    full = np.concatenate([full, rng.integers(0, 4, Qmax).astype(np.uint8)])
+    qs[2] = full[:Qmax]
+    qlens[2] = Qmax
+    mid = synth.mutate(rng, tpl, 0.03, 0.05, 0.05)[:Qmax]
+    qs[3, :len(mid)] = mid
+    qlens[3] = len(mid)
+    ts = np.broadcast_to(ts_row, (4, Tmax)).copy()
+    tlens = np.full(4, tl, np.int32)
+    _compare3(qs, qlens, ts, tlens, AlignParams(), with_stats=True)
+    _compare3(qs, qlens, ts, tlens, AlignParams(), with_stats=False)
+
+
+@pytest.mark.slow  # ~minutes: three full 64-hole scale-config CLI runs
+def test_scale64_bytes_invariant_across_impls(tmp_path, monkeypatch):
+    """The acceptance pin: the 64-hole scale config produces the SAME
+    output bytes (the committed md5) under all three CCSX_BANDED_IMPL
+    values — the impl knob is non-semantic (utils/fingerprint.py
+    _NON_SEMANTIC) and this is the test that earns it."""
+    import hashlib
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks"))
+    import fleet as fleet_bench
+
+    in_bam = fleet_bench.make_scale64_corpus(str(tmp_path))
+    for impl in ("scan", "pallas", "rotband"):
+        monkeypatch.setenv("CCSX_BANDED_IMPL", impl)
+        sub = tmp_path / impl
+        sub.mkdir()
+        ref = fleet_bench.run_scale64_reference(in_bam, str(sub))
+        assert hashlib.md5(ref).hexdigest() == fleet_bench.SCALE64_MD5, (
+            f"impl={impl}: scale64 bytes drifted "
+            f"({len(ref)} bytes vs pinned {fleet_bench.SCALE64_BYTES})")

@@ -394,6 +394,58 @@ def test_bench_vs_prev_quality_gate():
     assert any("q20_yield" in r for r in cur.get("regressed", []))
 
 
+def test_bench_vs_prev_dp_kernel_gate(monkeypatch):
+    """The dp-kernel leg of vs_prev (the r14 promotion harness): every
+    bench line embeds the newest pallas_ab decision record; a winner
+    flip is informational, but the winning arm's round throughput
+    dropping >20% on the SAME backend trips `regressed`; a backend
+    change gates nothing."""
+    bench = _bench_mod()
+    rec = {"winner": "rotband", "margin": 1.18,
+           "metric": "round_zmw_windows_per_sec",
+           "round_rates": {"scan": 80000.0, "pallas": 90000.0,
+                           "rotband": 100000.0},
+           "backend": "tpu", "interpret": False}
+    arts = [("pallas_ab_tpu_r07.json", dict(rec))]
+    monkeypatch.setattr(bench, "latest_pallas_ab_artifacts",
+                        lambda *a, **k: arts)
+    # same backend, winner steady, rate up: embeds + stays quiet
+    line, vp, reg = {}, {}, []
+    prev = {"dp_kernel": {**rec, "artifact": "pallas_ab_tpu_r06.json",
+                          "round_rates": {"rotband": 95000.0}}}
+    bench.compare_dp_kernel(line, prev, vp, reg)
+    assert line["dp_kernel"]["artifact"] == "pallas_ab_tpu_r07.json"
+    assert vp["dp_kernel"]["cur_winner"] == "rotband"
+    assert "winner_flipped" not in vp["dp_kernel"]
+    assert reg == []
+    # winning arm >20% slower on the same backend: tripped
+    line, vp, reg = {}, {}, []
+    prev_fast = {"dp_kernel": {**rec,
+                               "round_rates": {"rotband": 130000.0}}}
+    bench.compare_dp_kernel(line, prev_fast, vp, reg)
+    assert any("dp-kernel" in r for r in reg)
+    # winner flip: informational, not a regression by itself
+    line, vp, reg = {}, {}, []
+    prev_scan = {"dp_kernel": {**rec, "winner": "scan",
+                               "round_rates": {"scan": 80000.0}}}
+    bench.compare_dp_kernel(line, prev_scan, vp, reg)
+    assert vp["dp_kernel"].get("winner_flipped") is True
+    assert reg == []
+    # different backend (cpu interpret record vs tpu): no rate gate
+    line, vp, reg = {}, {}, []
+    prev_cpu = {"dp_kernel": {**rec, "backend": "cpu",
+                              "round_rates": {"rotband": 9e9}}}
+    bench.compare_dp_kernel(line, prev_cpu, vp, reg)
+    assert reg == []
+    # no prev record anywhere but a second artifact: it is the baseline
+    arts.append(("pallas_ab_tpu_r06.json",
+                 {**rec, "round_rates": {"rotband": 130000.0}}))
+    line, vp, reg = {}, {}, []
+    bench.compare_dp_kernel(line, None, vp, reg)
+    assert vp["dp_kernel"]["prev_source"] == "pallas_ab_tpu_r06.json"
+    assert any("dp-kernel" in r for r in reg)
+
+
 def test_bench_device_attempt_report(tmp_path):
     """A degraded CPU-fallback artifact must carry the failed device
     attempt's stall diagnostics: the watchdog's last in-flight shape

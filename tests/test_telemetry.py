@@ -351,6 +351,7 @@ def _populated_snapshot():
         setattr(m, f, 7)
     m.filtered_reasons["few_passes"] = 7
     m.corrupt_reasons["bgzf_bad_deflate"] = 7
+    m.banded_dispatches["scan"] = 7
     m.holes_total = 100
     m.degraded = "x"
     m.breaker_state = "open"
