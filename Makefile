@@ -4,7 +4,7 @@
 PY ?= python
 PYTEST_FLAGS = -q -p no:cacheprovider -p no:xdist -p no:randomly
 
-.PHONY: chaos chaos-soak fleet-chaos fuzz fuzz-sweep tier1 native long-molecule pallas-ab
+.PHONY: chaos chaos-soak fleet-chaos serve-chaos fuzz fuzz-sweep tier1 native long-molecule pallas-ab
 
 # the long-template (ultra-long-read) A/B: prefilter + device seeding
 # vs the legacy host path, interleaved arms, bytes asserted identical
@@ -42,6 +42,16 @@ fuzz-sweep:
 fleet-chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet.py $(PYTEST_FLAGS)
 	JAX_PLATFORMS=cpu $(PY) benchmarks/fleet.py --seed 0 --holes 6
+
+# the serving plane: the deterministic tier-1 slice (tests/
+# test_serve.py: concurrent byte identity + zero steady-state
+# recompiles, 429/cancel/drain-resume, per-tenant hang isolation)
+# then the seeded multi-tenant soak — cancel, device hang, salvage,
+# ENOSPC retry, drain/restart — against the blast-radius oracle
+# (also directly: python benchmarks/serve_chaos.py --seed N)
+serve-chaos:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serve.py $(PYTEST_FLAGS)
+	JAX_PLATFORMS=cpu $(PY) benchmarks/serve_chaos.py --seed 0 --holes 6
 
 # the full randomized soak (also available directly:
 # python benchmarks/chaos.py --seed N --trials T)

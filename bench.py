@@ -202,6 +202,78 @@ def compare_fleet(line, prev, vp, regressed):
             "regression)")
 
 
+def latest_serve_artifacts(root=_HERE, n=2):
+    """The ``n`` highest-numbered usable benchmarks/serve_r*.json
+    artifacts (the serving-plane chaos soak, benchmarks/serve_chaos.py),
+    newest first, as (name, summary) pairs.  Usable = carries the
+    steady-wave record (sustained zmws/s through the resident server
+    plus its steady-state recompile count); the summary also keeps the
+    one-bit all-trials verdict."""
+    import glob
+    import re
+
+    cands = []
+    for p in glob.glob(os.path.join(root, "benchmarks",
+                                    "serve_r*.json")):
+        m = re.search(r"serve_r(\d+)\.json$", p)
+        if m:
+            cands.append((int(m.group(1)), p))
+    out = []
+    for _, p in sorted(cands, reverse=True):
+        try:
+            with open(p) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            continue
+        steady = d.get("steady") or {}
+        if steady.get("zmws_per_sec") is None:
+            continue
+        out.append((os.path.basename(p),
+                    {"zmws_per_sec": steady["zmws_per_sec"],
+                     "recompiles": steady.get("recompiles"),
+                     "ok": d.get("ok")}))
+        if len(out) >= n:
+            break
+    return out
+
+
+def compare_serve(line, prev, vp, regressed):
+    """The serving leg of the vs_prev gate: sustained steady-wave
+    zmws/s through the resident server from the newest serve_r*.json
+    artifact vs the prior bench line's (or the second-newest artifact).
+    A >20% relative drop — or ANY failed trial in the newest soak, or
+    a NONZERO steady-state recompile count — trips ``regressed``: a
+    server that stops isolating tenants, stops being byte-exact, or
+    starts recompiling in steady state has lost the whole point of
+    residency.  CPU-hosted soak rates compare fine across rounds (same
+    harness, same corpus), so no backend gating applies."""
+    arts = latest_serve_artifacts()
+    if arts:
+        name, summary = arts[0]
+        line["serve"] = {"artifact": name, **summary}
+        if summary.get("ok") is False:
+            regressed.append(
+                f"serve soak {name} has failed trials (tenant "
+                "isolation / byte identity broke)")
+        if summary.get("recompiles"):
+            regressed.append(
+                f"serve soak {name} booked {summary['recompiles']} "
+                "steady-state recompiles (warm residency broke)")
+    cur = (line.get("serve") or {}).get("zmws_per_sec")
+    prev_s = ((prev or {}).get("serve") or {}).get("zmws_per_sec")
+    prev_src = "prev bench line"
+    if prev_s is None and len(arts) > 1:
+        prev_src, prev_s = arts[1][0], arts[1][1]["zmws_per_sec"]
+    if cur is None or prev_s is None:
+        return
+    vp["serve_zmws_per_sec"] = {"prev": prev_s, "cur": cur,
+                                "prev_source": prev_src}
+    if prev_s > 0 and cur < prev_s * REGRESSION_DROP:
+        regressed.append(
+            f"serve steady zmws_per_sec {prev_s}->{cur} (resident-"
+            "server throughput regression)")
+
+
 def latest_pallas_ab_artifacts(root=_HERE, n=2):
     """The ``n`` highest-numbered usable benchmarks/pallas_ab*_r*.json
     artifacts (the scan / Pallas v1 / rotband v2 promotion harness,
@@ -399,11 +471,12 @@ def compare_with_prev(line, prev, artifact):
             vp["zmws_per_sec_configs"] = ratios
             if g < REGRESSION_DROP:
                 regressed.append(f"e2e zmws_per_sec x{g:.2f}")
-    # the quality, fleet, and dp-kernel legs ride every comparison
-    # (all gate off committed artifacts; the dp-kernel leg does its
-    # own backend gating internally)
+    # the quality, fleet, serve, and dp-kernel legs ride every
+    # comparison (all gate off committed artifacts; the dp-kernel leg
+    # does its own backend gating internally)
     compare_quality(line, prev, vp, regressed)
     compare_fleet(line, prev, vp, regressed)
+    compare_serve(line, prev, vp, regressed)
     compare_dp_kernel(line, prev, vp, regressed)
     line["vs_prev"] = vp
     if regressed:
@@ -747,10 +820,11 @@ def _inner_main():
               "note": "no prior BENCH_r*.json artifact; vs_baseline "
                       "reports the native yardstick"}
         regressed = []
-        # the quality, fleet, and dp-kernel gates still apply: two
-        # artifacts can exist before any bench artifact does
+        # the quality, fleet, serve, and dp-kernel gates still apply:
+        # two artifacts can exist before any bench artifact does
         compare_quality(line, None, vp, regressed)
         compare_fleet(line, None, vp, regressed)
+        compare_serve(line, None, vp, regressed)
         compare_dp_kernel(line, None, vp, regressed)
         line["vs_prev"] = vp
         if regressed:

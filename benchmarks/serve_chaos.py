@@ -1,0 +1,269 @@
+"""Serving-plane chaos soak: multi-tenant blast-radius + steady-state.
+
+The resident server's claim (pipeline/serve.py) is two-sided:
+
+* **Isolation** — a fault INSIDE one tenant's job (a wedged device
+  dispatch, classified input corruption, a cancelled upload, ENOSPC on
+  its output) stays inside that job's fault domain: the job degrades,
+  retries, or fails by ITS budget/deadline, the concurrent sibling's
+  bytes match the solo CLI run exactly, and /readyz keeps answering
+  ready (the server never stops taking traffic because one tenant is
+  having a bad day).
+* **Steady state** — after the warm wave, a sustained stream of jobs
+  books ZERO new XLA compiles in the server tracer's cumulative group
+  table and holds a sustained zmws/s (the number bench.py's SERVE leg
+  gates round-over-round with the 20% rule).
+
+This soak drives both through one live ServeCore per process phase:
+
+  warm wave        2 concurrent clean jobs -> byte-identical, records
+                   the warm compile table
+  cancel_mid       a stalled job is cancelled mid-flight (rc 75);
+                   its sibling's bytes are untouched
+  device_hang      a tenant wedges its dispatch under its OWN 1.5 s
+                   dispatch deadline -> host-rung replay, byte-exact,
+                   hang counters booked ONLY in that job
+  corrupt_salvage  classified corruption under --salvage drops the
+                   damaged hole in THAT job only (rc 0 degraded)
+  disk_full_retry  injected ENOSPC fails the attempt rc 1; the serve
+                   retry RESUMES from the job journal to the
+                   byte-identical output (attempts == 2)
+  steady wave      N clean jobs timed -> sustained zmws/s, ZERO new
+                   compiles vs the warm table
+  drain_restart    SIGTERM semantics: drain with an in-flight job
+                   (rc 75, state "interrupted"), then a NEW core on
+                   the same spool requeues it from state.json and its
+                   journal resumes it byte-identically
+
+Schedules are pure functions of ``--seed`` (replayable); the corpus
+builder and reference runner are benchmarks/chaos.py's.  The fast
+deterministic slice of this story is tier-1 (tests/test_serve.py);
+this soak is the composition proof:
+
+    python benchmarks/serve_chaos.py --seed 0 --holes 6 \
+        --json benchmarks/serve_rNN.json        (`make serve-chaos`)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# unit-scale fault budgets: eager journal settles (the disk_full retry
+# must resume, not recompute), short injected stalls, no first-of-shape
+# deadline grace, bounded hang parks
+os.environ["CCSX_JOURNAL_FSYNC_S"] = "0"
+os.environ["CCSX_FAULT_STALL_S"] = "2"
+os.environ["CCSX_DEADLINE_GRACE"] = "1"
+os.environ["CCSX_FAULT_HANG_S"] = "60"
+
+from ccsx_tpu import cli, exitcodes                          # noqa: E402
+from ccsx_tpu.pipeline.serve import ServeCore                # noqa: E402
+from benchmarks.chaos import make_corpus, run_reference      # noqa: E402
+
+
+def _cfg():
+    return cli.config_from_args(
+        cli.build_parser().parse_args(["-A", "-m", "1000"]))
+
+
+def _compiles(core) -> int:
+    groups = core.metrics.snapshot().get("groups") or {}
+    return sum(g["compiles"] for g in groups.values())
+
+
+def _bytes(path: str) -> bytes:
+    try:
+        return open(path, "rb").read()
+    except OSError:
+        return b""
+
+
+def _pair(core, in_fa: str, ref: bytes, overrides: dict, kind: str):
+    """One faulted job + one clean sibling, concurrently.  The
+    sibling's byte identity + clean counters IS the blast-radius
+    oracle; readiness is sampled while both run."""
+    bad = core.submit(input_path=in_fa, overrides=overrides)
+    good = core.submit(input_path=in_fa)
+    ready_during = core.readiness()[0]
+    t = {"kind": kind, "bad": core.wait(bad.id, 300),
+         "good": core.wait(good.id, 300)}
+    snaps = core.job_snapshots()
+    t["bad_job"], t["good_job"] = bad.id, good.id
+    t["bad_metrics"] = {k: snaps.get(bad.id, {}).get(k) for k in
+                        ("holes_out", "holes_corrupt", "device_hangs",
+                         "host_fallbacks", "breaker_trips")}
+    t["sibling_identical"] = _bytes(good.out_path) == ref
+    t["sibling_clean"] = (snaps.get(good.id, {}).get("device_hangs")
+                          == 0 and
+                          snaps.get(good.id, {}).get("holes_corrupt")
+                          == 0)
+    t["ready_during"] = ready_during
+    t["ready_after"] = core.readiness()[0]
+    return bad, good, snaps, t
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--holes", type=int, default=6)
+    ap.add_argument("--steady-jobs", type=int, default=6)
+    ap.add_argument("--json", default=None,
+                    help="write the artifact here "
+                         "(benchmarks/serve_rNN.json)")
+    a = ap.parse_args(argv)
+    rng = np.random.default_rng(a.seed)
+    t_start = time.time()
+    trials = []
+
+    with tempfile.TemporaryDirectory() as tmp:
+        in_fa = make_corpus(tmp, rng, a.holes)
+        # the solo-CLI reference MUST run before the core exists (the
+        # server owns the installed tracer for its process lifetime)
+        ref = run_reference(in_fa, tmp)
+        spool = os.path.join(tmp, "spool")
+        core = ServeCore(_cfg(), spool=spool, max_active=3,
+                         retries=2, backoff_s=0.1)
+        try:
+            # ---- warm wave ----
+            warm = [core.submit(input_path=in_fa) for _ in range(2)]
+            states = [core.wait(j.id, 300) for j in warm]
+            ident = [_bytes(j.out_path) == ref for j in warm]
+            warm_compiles = _compiles(core)
+            trials.append({"kind": "warm_wave", "states": states,
+                           "identical": ident,
+                           "compiles": warm_compiles,
+                           "ok": states == ["done"] * 2 and all(ident)})
+
+            # ---- cancel mid-flight ----
+            bad = core.submit(input_path=in_fa,
+                              overrides={"faults": "stall@1"})
+            good = core.submit(input_path=in_fa)
+            time.sleep(0.5)          # inside the stalled dispatch
+            core.cancel(bad.id)
+            t = {"kind": "cancel_mid",
+                 "bad": core.wait(bad.id, 300),
+                 "good": core.wait(good.id, 300),
+                 "bad_rc": core.job(bad.id).rc,
+                 "sibling_identical": _bytes(good.out_path) == ref,
+                 "ready_after": core.readiness()[0]}
+            t["ok"] = (t["bad"] == "cancelled"
+                       and t["bad_rc"] == exitcodes.RC_INTERRUPTED
+                       and t["good"] == "done"
+                       and t["sibling_identical"] and t["ready_after"])
+            trials.append(t)
+
+            # ---- device hang, isolated by the tenant's own deadline --
+            bad, good, snaps, t = _pair(
+                core, in_fa, ref,
+                {"faults": "device_hang@1",
+                 "dispatch_deadline_s": 1.5}, "device_hang")
+            t["bad_identical"] = _bytes(bad.out_path) == ref
+            t["ok"] = (t["bad"] == "done" and t["good"] == "done"
+                       and t["bad_identical"] and t["sibling_identical"]
+                       and t["sibling_clean"]
+                       and t["bad_metrics"]["device_hangs"] >= 1
+                       and t["bad_metrics"]["host_fallbacks"] >= 1
+                       and t["ready_after"])
+            trials.append(t)
+
+            # ---- classified corruption under salvage ----
+            n = int(rng.integers(2, a.holes))
+            bad, good, snaps, t = _pair(
+                core, in_fa, ref,
+                {"faults": f"input_corrupt@{n}", "salvage": True},
+                "corrupt_salvage")
+            t["spec"] = f"input_corrupt@{n}"
+            corrupt = t["bad_metrics"]["holes_corrupt"] or 0
+            t["ok"] = (t["bad"] == "done" and t["good"] == "done"
+                       and corrupt >= 1
+                       and t["bad_metrics"]["holes_out"]
+                       == a.holes - corrupt
+                       and t["sibling_identical"] and t["sibling_clean"]
+                       and t["ready_after"])
+            trials.append(t)
+
+            # ---- ENOSPC -> rc 1 -> serve retry RESUMES the journal --
+            # the fault index must sit past the resume's write count:
+            # attempt 1 journals holes 1..n-1, the re-armed scope's
+            # attempt 2 only writes holes n..H (H-n+1 < n calls)
+            n = a.holes - 1
+            bad, good, snaps, t = _pair(
+                core, in_fa, ref, {"faults": f"disk_full@{n}"},
+                "disk_full_retry")
+            t["spec"] = f"disk_full@{n}"
+            t["attempts"] = core.job(bad.id).attempts
+            t["bad_identical"] = _bytes(bad.out_path) == ref
+            t["ok"] = (t["bad"] == "done" and t["attempts"] == 2
+                       and t["bad_identical"] and t["good"] == "done"
+                       and t["sibling_identical"] and t["ready_after"])
+            trials.append(t)
+
+            # ---- steady wave: sustained rate, zero new compiles ----
+            pre = _compiles(core)
+            t0 = time.monotonic()
+            jobs = [core.submit(input_path=in_fa)
+                    for _ in range(a.steady_jobs)]
+            states = [core.wait(j.id, 600) for j in jobs]
+            wall = time.monotonic() - t0
+            ident = [_bytes(j.out_path) == ref for j in jobs]
+            recompiles = _compiles(core) - pre
+            steady = {"kind": "steady_wave", "jobs": a.steady_jobs,
+                      "wall_s": round(wall, 2),
+                      "zmws_per_sec":
+                      round(a.steady_jobs * a.holes / wall, 3),
+                      "recompiles": recompiles,
+                      "ok": (states == ["done"] * a.steady_jobs
+                             and all(ident) and recompiles == 0)}
+            trials.append(steady)
+
+            # ---- SIGTERM drain with in-flight work ----
+            j = core.submit(input_path=in_fa,
+                            overrides={"faults": "stall@1",
+                                       "inflight": 1})
+            time.sleep(0.5)
+            rc = core.drain(timeout=120)
+            t = {"kind": "drain_restart", "drain_rc": rc,
+                 "state_at_exit": core.job(j.id).state}
+        finally:
+            core.close()
+
+        # ---- restart: state.json requeues, the journal resumes ----
+        core2 = ServeCore(_cfg(), spool=spool, max_active=1)
+        try:
+            t["resume_state"] = core2.wait(j.id, 300)
+            t["identical"] = _bytes(core2.job(j.id).out_path) == ref
+        finally:
+            core2.close()
+        t["ok"] = (t["drain_rc"] == exitcodes.RC_INTERRUPTED
+                   and t["state_at_exit"] == "interrupted"
+                   and t["resume_state"] == "done" and t["identical"])
+        trials.append(t)
+
+    n_failed = sum(1 for t in trials if not t.get("ok"))
+    out = {"seed": a.seed, "holes": a.holes,
+           "steady": next(t for t in trials
+                          if t["kind"] == "steady_wave"),
+           "trials": trials, "n_trials": len(trials),
+           "n_failed": n_failed, "ok": n_failed == 0,
+           "elapsed_s": round(time.time() - t_start, 1)}
+    blob = json.dumps(out, indent=1)
+    print(blob)
+    if a.json:
+        with open(a.json, "w") as f:
+            f.write(blob)
+    return 0 if n_failed == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -173,6 +173,23 @@ ccsx-tpu report <jsonl>.. (self-contained HTML run report from trace/
                            compile/execute table, stage breakdown,
                            occupancy tiles, stall/recovery log,
                            ETA-vs-actual curve; -o <out.html>)
+ccsx-tpu serve [opts]     (resident multi-tenant consensus server:
+                           one warm runtime — executors, warmup
+                           compiles, tracer — shared by jobs
+                           submitted over HTTP on the telemetry
+                           stack: POST /jobs (input path or streamed
+                           BAM/FASTQ body), GET /jobs/<id> status,
+                           GET /jobs/<id>/output, DELETE cancels;
+                           /healthz liveness vs /readyz readiness.
+                           Per-job fault isolation: own journal,
+                           failure budget, breaker scope, metrics
+                           label; fair shared admission window;
+                           --job-deadline + bounded retry; queue cap
+                           -> 429 + Retry-After; SIGTERM drains to a
+                           resumable rc 75 and a restart requeues
+                           unfinished jobs from <spool>/state.json.
+                           Compute flags after the serve flags are
+                           the normal run options)
 """
 
 
@@ -613,6 +630,11 @@ def main(argv: Optional[list] = None) -> int:
         from ccsx_tpu.utils import report as report_mod
 
         return report_mod.report_main(argv[1:])
+    if argv and argv[0] == "serve":
+        # resident multi-tenant consensus server (pipeline/serve.py)
+        from ccsx_tpu.pipeline.serve import serve_main
+
+        return serve_main(argv[1:])
     args = build_parser().parse_args(argv)
     if args.help:
         return usage()  # rc 1, like the reference (main.c:761)

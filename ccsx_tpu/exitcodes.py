@@ -25,7 +25,10 @@ Codes:
   The run is RESUMABLE: re-run the same command (with the same
   --journal) and it continues to a byte-identical output.  75 is
   sysexits' EX_TEMPFAIL ("temporary failure, retry"), which is
-  exactly the contract.
+  exactly the contract.  ``ccsx-tpu serve`` reuses the code for a
+  server drain with unfinished jobs (pipeline/serve.py): restarting
+  the same command requeues them from <spool>/state.json and their
+  per-job journals resume them byte-identically.
 * ``RC_INJECTED_KILL`` (57) — a fault-injection hard exit
   (utils/faultinject.py write/journal/rank_death points, os._exit);
   distinctive so tests and operators can tell an injected kill from a

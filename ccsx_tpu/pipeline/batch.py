@@ -1022,7 +1022,8 @@ class PairExecutor:
 
     def __init__(self, params: AlignParams, quant: int = 512,
                  metrics=None, warmup=None, resil=None,
-                 prefilter: bool = True, seed_device_min_t: int = 16384):
+                 prefilter: bool = True, seed_device_min_t: int = 16384,
+                 warm_cache: Optional[set] = None):
         self.params = params
         self.quant = quant
         self.metrics = metrics
@@ -1031,7 +1032,13 @@ class PairExecutor:
         # refine dispatches — a wedged chip wedges both
         self._resil = resil
         self._warmup = warmup      # AOT precompiler (pipeline/warmup.py)
-        self._warmed: set = set()  # inline-warm dedupe (no compiler)
+        # inline-warm dedupe (no compiler).  ``warm_cache`` lets a
+        # resident server pass ONE set shared by every job's executor:
+        # the jit caches behind these keys are process-wide (module-
+        # level lru_cache factories), so job 2 re-warming job 1's
+        # (qmax, tmax, N) bucket would pay a pointless zero-slab pass
+        self._warmed: set = warm_cache if warm_cache is not None \
+            else set()
         self._host_aligner = None  # built lazily, on first fallback
         self.prefilter = bool(prefilter)
         self.seed_device_min_t = max(0, int(seed_device_min_t))
@@ -2396,7 +2403,8 @@ def _grow_window(window: int, cap: int, growth: int) -> int:
 
 
 def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
-                  metrics: Metrics, inflight: Optional[int] = None) -> int:
+                  metrics: Metrics, inflight: Optional[int] = None,
+                  shared=None) -> int:
     """The batched scheduler loop over an open ZMW stream and writer.
 
     Shared by the single-process driver (run_pipeline_batched) and the
@@ -2404,6 +2412,29 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
     exposes ``put_at(idx, name, seq, qual)`` it receives each record's
     hole ordinal too (the distributed shard writer needs it to restore
     global order at merge time).
+
+    ``shared``: the resident server's runtime (pipeline/serve.py
+    SharedRuntime) when this driver runs as ONE TENANT JOB of a
+    ``ccsx-tpu serve`` process instead of owning the process.  Duck-
+    typed attributes, all optional:
+
+    * ``warm`` — a server-lifetime WarmupCompiler (not closed here;
+      its key-dedup makes job N+1 skip every executable job 1 built)
+    * ``warm_cache`` — one set shared by every job's PairExecutor for
+      the inline-warm dedupe (the no-compiler path)
+    * ``guard`` — a drain surrogate (utils/drain.FlagGuard) the server
+      raises on cancel / deadline / server drain; replaces the
+      process-signal DrainGuard (signal handlers belong to the
+      server's main thread, not to a job thread)
+    * ``admission`` — a per-job handle on the server's fair shared
+      admission window (serve.JobAdmission): a slot is acquired per
+      hole admitted and released when the hole finishes computing, so
+      N tenants split the device window instead of stacking N windows
+
+    With ``shared`` set the driver also does NOT install a tracer or
+    start telemetry — the server owns the process-global tracer (one
+    compile table across jobs is exactly the zero-recompile criterion)
+    and the HTTP stack.
 
     ``inflight``: an EXPLICIT admission window pins it (the old fixed
     behavior); None selects the reference's adaptive chunk-growth
@@ -2442,13 +2473,23 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
     # ingest/prep — the first dispatch of a warmed shape then runs at
     # steady-state speed (and books as execute in the tracer)
     warm = None
-    if getattr(cfg, "warmup_compile", True):
+    own_warm = True
+    if shared is not None and getattr(shared, "warm", None) is not None:
+        warm = shared.warm
+        own_warm = False       # server-lifetime: never closed here
+    elif getattr(cfg, "warmup_compile", True):
         from ccsx_tpu.pipeline.warmup import WarmupCompiler
 
         warm = WarmupCompiler()
+    # the fair shared-admission handle (serve.JobAdmission), None for
+    # a process-owning run: one slot per admitted-and-still-computing
+    # hole, released the moment the hole finishes
+    adm = getattr(shared, "admission", None)
     # resilient execution (pipeline/resilience.py): one dispatch-
     # deadline runner + circuit breaker shared by BOTH executors, so
-    # pair-fill and refine failures count against the same backend
+    # pair-fill and refine failures count against the same backend.
+    # Deliberately PER JOB under serve: a tenant that wedges the chip
+    # trips only its own breaker to the host rung
     resil = resil_mod.Resilience(cfg, metrics=metrics)
     executor = BatchExecutor(cfg, metrics=metrics, warmup=warm,
                              resil=resil)
@@ -2456,7 +2497,9 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
                                  metrics=metrics, warmup=warm,
                                  resil=resil,
                                  prefilter=cfg.prefilter,
-                                 seed_device_min_t=cfg.seed_device_min_t)
+                                 seed_device_min_t=cfg.seed_device_min_t,
+                                 warm_cache=getattr(shared, "warm_cache",
+                                                    None))
 
     def warm_hole(h) -> None:
         if warm is not None and isinstance(h.req, RefineRequest):
@@ -2526,6 +2569,8 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
     def admit(h):
         if h.done:
             finished[h.idx] = h
+            if adm is not None:
+                adm.release()  # never computed: free the slot at once
         else:
             warm_hole(h)
             active.append(h)
@@ -2535,8 +2580,13 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
     # prep pool's background workers — consumes the wrapped stream.
     # Installed HERE, immediately before the try whose finally restores
     # the handlers: installing any earlier would leak them if an
-    # executor/resilience constructor above raised
-    guard = DrainGuard.install()
+    # executor/resilience constructor above raised.  A serve job gets
+    # its owner's FlagGuard instead — the server's main thread owns
+    # the real signal handlers
+    if shared is not None and getattr(shared, "guard", None) is not None:
+        guard = shared.guard
+    else:
+        guard = DrainGuard.install()
     stream = guarded_stream(stream, cfg, metrics, guard)
     # the flight recorder (utils/trace.py): span JSONL under --trace,
     # and the stall watchdog + group attribution regardless — the
@@ -2548,22 +2598,24 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
     tracer = None
     telem = None
     try:
-        try:
-            tracer = trace.Tracer(cfg.trace_path,
-                                  stall_timeout=cfg.stall_timeout_s,
-                                  metrics=metrics)
-        except OSError as e:
-            print(f"Cannot open trace file for write! ({e})",
-                  file=sys.stderr)
-            return 1
-        trace.install(tracer)
-        # live telemetry endpoints (--telemetry-port; sharded runs
-        # arrive here with the port already rank-offset).  None when
-        # off; a bind failure degrades to a warning, never kills a run
-        if cfg.telemetry_port:
-            from ccsx_tpu.utils import telemetry
+        if shared is None:
+            try:
+                tracer = trace.Tracer(cfg.trace_path,
+                                      stall_timeout=cfg.stall_timeout_s,
+                                      metrics=metrics)
+            except OSError as e:
+                print(f"Cannot open trace file for write! ({e})",
+                      file=sys.stderr)
+                return 1
+            trace.install(tracer)
+            # live telemetry endpoints (--telemetry-port; sharded runs
+            # arrive here with the port already rank-offset).  None
+            # when off; a bind failure degrades to a warning, never
+            # kills a run
+            if cfg.telemetry_port:
+                from ccsx_tpu.utils import telemetry
 
-            telem = telemetry.start(metrics, cfg.telemetry_port)
+                telem = telemetry.start(metrics, cfg.telemetry_port)
         if n_prep > 0:
             # the overlapped prep plane: ingest + the orientation walk
             # move to background threads (constructed after the tracer
@@ -2579,8 +2631,12 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
                 # NEVER blocking here: with device work pending, the
                 # sweep must run while prep keeps working in background
                 while len(active) < window:
+                    if adm is not None and not adm.try_acquire():
+                        break  # at fair share; sweep what we hold
                     h = pool.poll()
                     if h is None:
+                        if adm is not None:
+                            adm.release()  # nothing arrived for it
                         break
                     admit(h)
                 admitted_full = len(active) >= window
@@ -2591,12 +2647,16 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
                 # so a filtered run can't grow memory unboundedly
                 while (not exhausted and len(active) < window
                        and next_idx - next_emit < 4 * cap):
+                    if adm is not None and not adm.try_acquire():
+                        break  # at fair share; sweep what we hold
                     try:
                         with metrics.timer("ingest"), \
                                 trace.span("ingest_hole", cat="ingest"):
                             z = next(stream)
                             faultinject.fire("ingest")
                     except StopIteration:
+                        if adm is not None:
+                            adm.release()
                         exhausted = True
                         break
                     metrics.holes_in += 1
@@ -2634,6 +2694,16 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
                 # the moment prep pauses with work in hand — or the
                 # window fills — sweep what we have.
                 while len(active) < window and not pool.drained():
+                    if adm is not None and not adm.try_acquire():
+                        # at fair share while another tenant wants the
+                        # window: wait on the admission condition (a
+                        # release anywhere re-checks), not on the pool
+                        adm.wait(0.05 if active else 0.2)
+                        emit_ready()
+                        if active:
+                            break
+                        metrics.heartbeat()
+                        continue
                     # only the wait itself books as blocked — emission
                     # (write + journal fsync) has its own stage, and
                     # prep_share is the acceptance counter
@@ -2645,6 +2715,8 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
                     # than the 4x bound live-locks against the pool
                     emit_ready()
                     if h is None:
+                        if adm is not None:
+                            adm.release()
                         if active:
                             break
                         metrics.heartbeat()
@@ -2687,6 +2759,11 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
             for h in active:
                 if h.done:
                     finished[h.idx] = h
+                    if adm is not None:
+                        adm.release()  # finished computing: free the
+                        # slot before emission (which can lag on an
+                        # out-of-order tail) so a sibling job's denied
+                        # admission unblocks now
                 else:
                     # a sweep can grow a hole's draft into a fresh
                     # (qmax, tmax) group — predict next wave's shapes
@@ -2720,6 +2797,11 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
         rc = 1
     finally:
         guard.restore()
+        # settle this job's admission slots whatever the exit path —
+        # a crashed tenant must not strand capacity the fair window
+        # still counts against its share
+        if adm is not None:
+            adm.reset()
         try:
             writer.close()
         except OSError as e:
@@ -2735,12 +2817,17 @@ def drive_batched(stream, writer, cfg: CcsConfig, journal: Journal,
             pool.close()
         # stop the warmup thread (drops queued compiles; an in-flight
         # build finishes) BEFORE the tracer closes, so no warmup span
-        # outlives the trace file
-        if warm is not None:
+        # outlives the trace file.  A server-lifetime compiler stays
+        # up — its queue is the next job's head start
+        if warm is not None and own_warm:
             warm.close()
         # stop the watchdog + export the trace BEFORE the final metrics
-        # event, so a degraded mark set mid-run is in the "final"
-        trace.uninstall()
+        # event, so a degraded mark set mid-run is in the "final".
+        # Under serve the PROCESS-GLOBAL tracer is the server's (one
+        # compile table across jobs); uninstalling it here would blind
+        # every sibling job's attribution
+        if shared is None:
+            trace.uninstall()
         if tracer is not None:
             tracer.close()
         # endpoints down BEFORE the final event: a scraper must never
@@ -2778,15 +2865,26 @@ def mesh_precheck(cfg: CcsConfig) -> int:
 
 def run_pipeline_batched(in_path: str, out_path: str, cfg: CcsConfig,
                          journal_path: Optional[str] = None,
-                         inflight: Optional[int] = None) -> int:
-    """Batched end-to-end driver (CLI --batch; default on TPU backends)."""
+                         inflight: Optional[int] = None,
+                         metrics: Optional[Metrics] = None,
+                         shared=None) -> int:
+    """Batched end-to-end driver (CLI --batch; default on TPU backends).
+
+    ``metrics``/``shared``: the serving plane (pipeline/serve.py) runs
+    each tenant job through this exact entry point, handing in the
+    job-labelled Metrics it scrapes for /jobs/<id> and the server's
+    SharedRuntime (see drive_batched) — so a served job and a CLI run
+    are the same code path end to end, which is what makes the
+    byte-identity acceptance test meaningful."""
     from ccsx_tpu.pipeline.run import (holes_total_hint, open_writer,
                                        open_zmw_stream)
     from ccsx_tpu.utils.device import resolve_device
 
     # metrics constructed before the stream so both ingest paths can
     # book their filtered-hole accounting into it
-    metrics = Metrics(verbose=cfg.verbose, stream=cfg.metrics_stream())
+    if metrics is None:
+        metrics = Metrics(verbose=cfg.verbose,
+                          stream=cfg.metrics_stream())
     metrics.holes_total = holes_total_hint(in_path, cfg)
     try:
         stream = open_zmw_stream(in_path, cfg, metrics=metrics)
@@ -2815,4 +2913,5 @@ def run_pipeline_batched(in_path: str, out_path: str, cfg: CcsConfig,
         metrics.close_stream()
         return 1
     # None = the adaptive admission window (explicit --inflight pins it)
-    return drive_batched(stream, writer, cfg, journal, metrics, inflight)
+    return drive_batched(stream, writer, cfg, journal, metrics, inflight,
+                         shared=shared)

@@ -94,8 +94,11 @@ class _PairGate:
         self._cv = threading.Condition()
         self._pending: List[list] = []   # [req, Event, result]
         self._stop = False
-        self._thread = threading.Thread(target=self._pump, daemon=True,
-                                        name="ccsx-prep-pairs")
+        # faultinject.inherit: the pump must stay inside the spawning
+        # job's fault scope (serve runs many jobs in one process)
+        self._thread = threading.Thread(
+            target=faultinject.inherit(self._pump), daemon=True,
+            name="ccsx-prep-pairs")
         self._thread.start()
 
     def align(self, req):
@@ -173,9 +176,10 @@ class PrepPool:
         self._ingest_error: Optional[BaseException] = None
         self._stop = False
         metrics.prep_threads = max(1, int(threads))
+        # workers run inside the spawning job's fault scope (see pump)
         self._threads = [
-            threading.Thread(target=self._work, daemon=True,
-                             name=f"ccsx-prep-{i}")
+            threading.Thread(target=faultinject.inherit(self._work),
+                             daemon=True, name=f"ccsx-prep-{i}")
             for i in range(max(1, int(threads)))]
         for t in self._threads:
             t.start()

@@ -53,6 +53,8 @@ import threading
 import time
 from typing import Optional
 
+from ccsx_tpu.utils import faultinject
+
 # first-of-(group, phase) bounded calls get grace x the deadline — the
 # same cold-compile allowance as the stall watchdog's COMPILE_GRACE.
 # Env override (CCSX_DEADLINE_GRACE) exists for tests and chaos runs
@@ -111,7 +113,10 @@ def bounded_call(fn, timeout_s: float, label: str = "",
         finally:
             done.set()
 
-    t = threading.Thread(target=_run, daemon=True,
+    # inherit() carries the caller's fault scope into the worker: a
+    # serve job's device_hang injection must fire inside ITS bounded
+    # dispatch, not whichever tenant's thread spawns next
+    t = threading.Thread(target=faultinject.inherit(_run), daemon=True,
                          name=f"ccsx-bounded-{phase}")
     t.start()
     if done.wait(timeout_s):

@@ -1,0 +1,997 @@
+"""Resident consensus service: multi-tenant `ccsx-tpu serve`.
+
+The CLI pays its startup tax — jax import, backend init, and the AOT
+warmup compiles — once PER RUN; a lab submitting many small jobs pays
+it once per JOB.  This module keeps one warm process resident and runs
+jobs through the SAME batched driver the CLI uses
+(pipeline/batch.run_pipeline_batched), so a served job's output is
+byte-identical to the CLI run of the same input by construction, while
+job 2..N skip every XLA compile job 1 booked (the module-level jitted
+step factories are process-wide, the WarmupCompiler below is
+server-lifetime, and the zero-steady-state-recompile criterion is
+enforced by tests/test_serve.py against the server tracer's group
+table).
+
+**Job API** (mounted on the existing telemetry HTTP stack,
+utils/telemetry.py — one server, one port):
+
+  POST   /jobs            submit: JSON {"input": path, ...overrides}
+                          or a streamed BAM/FASTQ request body
+                          (?format=bam|fastq|fasta); 201 {"id": ...},
+                          429 + Retry-After at the queue-depth cap
+  GET    /jobs            all jobs (id, state, rc, counters)
+  GET    /jobs/<id>        one job's status + fault-domain metrics
+  GET    /jobs/<id>/output stream the finished FASTA/FASTQ
+  DELETE /jobs/<id>        cancel (running jobs drain via their guard)
+  GET    /healthz          LIVENESS: 200 while the process serves
+  GET    /readyz           READINESS: 503 {"ready": false, reason}
+                          while warming (cold compiles pending),
+                          draining, or at the queue cap
+  GET    /metrics          server Prometheus series + per-job
+                          ccsx_job_*{job="..."} series
+  GET    /progress         the server Metrics snapshot (cumulative
+                          group compile table across all jobs)
+
+**Per-job fault domains under shared capacity.**  Each job gets its
+own journal, its own Metrics (labeled ``job=<id>``), its own failure
+budget / corruption accounting, its own Resilience (so a
+tenant-induced device hang trips only that job's breaker to the host
+rung), its own drain guard (utils/drain.FlagGuard — cancel, deadline,
+and server drain all route through the drivers' existing rc-75 drain
+path), and its own fault-injection scope
+(utils/faultinject.scope_arm: a job's ``faults`` spec fires only on
+that job's thread family).  What jobs SHARE is capacity: the
+FairWindow below splits the device admission window (cfg.
+zmw_microbatch slots) round-robin-fairly — a tenant at its fair share
+is denied further slots while another tenant wants them — and the
+window-size invariance the batched driver pins (output bytes identical
+across admission windows) is exactly what makes fair sharing safe for
+byte identity.
+
+**Lifecycle.**  Transient failures (rc 1: ENOSPC, torn writes) retry
+with exponential backoff up to --job-retries — the per-job journal
+makes a retry resume, not recompute.  rc 2 (failure budget) and
+cancellation are terminal.  --job-deadline bounds a job's wall clock
+across attempts (exceeding it drains the job and fails it).  SIGTERM
+drains the SERVER: stop accepting, drain running jobs (their journals
+settle), persist the queue to <spool>/state.json, exit rc 75
+(EX_TEMPFAIL) — restarting the same command requeues unfinished jobs
+and completes them byte-identically.  benchmarks/serve_chaos.py is the
+seeded soak that proves the blast radius of each fault class stays in
+the faulted job.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ccsx_tpu import exitcodes
+from ccsx_tpu.config import CcsConfig
+from ccsx_tpu.utils import faultinject
+from ccsx_tpu.utils.drain import FlagGuard
+from ccsx_tpu.utils.journal import write_json_atomic
+from ccsx_tpu.utils.metrics import Metrics
+
+STATE_FILE = "state.json"
+# terminal-for-this-process states ("interrupted" is resumable by a
+# server restart, but this process will not touch the job again)
+TERMINAL = ("done", "failed", "cancelled", "interrupted")
+# job cfg overrides accepted from a submission, with their coercions —
+# every one is journal-non-semantic (pipeline/journal _NON_SEMANTIC) or
+# consumed before the journal fingerprint, so an override can never
+# poison a resume
+_CFG_OVERRIDES = {
+    "salvage": ("salvage", lambda v: _truthy(v)),
+    "max_failed_holes": ("max_failed_holes", float),
+    "dispatch_deadline_s": ("dispatch_deadline_s", float),
+    "breaker_strikes": ("breaker_strikes", int),
+    "prep_threads": ("prep_threads", int),
+}
+# job-level (non-cfg) override keys
+_JOB_OVERRIDES = ("format", "output", "deadline_s", "faults", "inflight")
+
+
+def _truthy(v) -> bool:
+    if isinstance(v, str):
+        return v.strip().lower() in ("1", "true", "yes", "on")
+    return bool(v)
+
+
+class QueueFull(Exception):
+    """Submission refused at the queue-depth cap (HTTP 429)."""
+
+
+class Draining(Exception):
+    """Submission refused because the server is draining (HTTP 503)."""
+
+
+# ---- fair shared admission ------------------------------------------------
+
+class FairWindow:
+    """The device admission window as a shared resource: ``capacity``
+    slots (cfg.zmw_microbatch — the same cap a solo run's window grows
+    to) split fairly across registered jobs.
+
+    Fairness rule: a job may always take a free slot UNLESS it already
+    holds its fair share (ceil(capacity / registered jobs)) while some
+    OTHER job is wanting (was denied and has not succeeded since) — a
+    lone tenant gets the whole window, and a second tenant's first
+    denial immediately caps the first at half.  Slots track holes that
+    are admitted AND still computing (pipeline/batch.drive_batched
+    releases on hole completion, before emission), so a job with an
+    out-of-order emission tail is not charged for holes the device is
+    done with.
+
+    A stale "wanting" mark (a job denied once that then stopped
+    asking) can cap siblings below the full window until that job
+    releases to zero or unregisters — a bounded throughput nick, never
+    a correctness issue: output bytes are invariant to window size
+    (the pinned invariance that makes fair sharing safe at all)."""
+
+    def __init__(self, capacity: int):
+        self.capacity = max(1, int(capacity))
+        self._cv = threading.Condition()
+        self._held: Dict[str, int] = {}
+        self._want: set = set()
+
+    def register(self, jid: str) -> None:
+        with self._cv:
+            self._held.setdefault(jid, 0)
+            self._cv.notify_all()
+
+    def unregister(self, jid: str) -> None:
+        with self._cv:
+            self._held.pop(jid, None)
+            self._want.discard(jid)
+            self._cv.notify_all()
+
+    def try_acquire(self, jid: str) -> bool:
+        with self._cv:
+            held = self._held.get(jid, 0)
+            if sum(self._held.values()) >= self.capacity:
+                self._want.add(jid)
+                return False
+            share = -(-self.capacity // max(1, len(self._held)))
+            if held >= share and any(j != jid for j in self._want):
+                self._want.add(jid)
+                return False
+            self._held[jid] = held + 1
+            self._want.discard(jid)
+            return True
+
+    def release(self, jid: str) -> None:
+        with self._cv:
+            n = self._held.get(jid, 0)
+            if n > 0:
+                self._held[jid] = n - 1
+            self._cv.notify_all()
+
+    def release_all(self, jid: str) -> None:
+        with self._cv:
+            if self._held.get(jid):
+                self._held[jid] = 0
+            self._want.discard(jid)
+            self._cv.notify_all()
+
+    def wait(self, timeout: Optional[float]) -> None:
+        with self._cv:
+            self._cv.wait(timeout)
+
+
+class JobAdmission:
+    """One job's handle on the FairWindow — the duck-typed
+    ``admission`` attribute drive_batched consumes (try_acquire /
+    release / wait / reset)."""
+
+    def __init__(self, window: FairWindow, jid: str):
+        self._w = window
+        self._jid = jid
+        window.register(jid)
+
+    def try_acquire(self) -> bool:
+        return self._w.try_acquire(self._jid)
+
+    def release(self) -> None:
+        self._w.release(self._jid)
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        self._w.wait(timeout)
+
+    def reset(self) -> None:
+        self._w.release_all(self._jid)
+
+    def close(self) -> None:
+        self._w.unregister(self._jid)
+
+
+class _JobRuntime:
+    """The ``shared`` object handed to drive_batched: the server-owned
+    pieces (warm, warm_cache) plus the job-owned ones (guard,
+    admission)."""
+
+    def __init__(self, warm, warm_cache, guard, admission):
+        self.warm = warm
+        self.warm_cache = warm_cache
+        self.guard = guard
+        self.admission = admission
+
+
+# ---- jobs -----------------------------------------------------------------
+
+class Job:
+    def __init__(self, jid: str, in_path: str, out_path: str,
+                 journal_path: str, cfg: CcsConfig,
+                 overrides: Optional[dict] = None):
+        self.id = jid
+        self.in_path = in_path
+        self.out_path = out_path
+        self.journal_path = journal_path
+        self.cfg = cfg
+        self.raw_overrides = dict(overrides or {})
+        self.state = "queued"
+        self.rc: Optional[int] = None
+        self.error: Optional[str] = None
+        self.attempts = 0
+        self.deadline_s = 0.0
+        self.faults: Optional[str] = None
+        self.inflight: Optional[int] = None
+        self.submitted_at = time.time()
+        self.started_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.stop_reason: Optional[str] = None
+        self.metrics: Optional[Metrics] = None
+        self.snap: Optional[dict] = None
+        self.guard: Optional[FlagGuard] = None
+        self.thread: Optional[threading.Thread] = None
+        self._stop_ev = threading.Event()
+
+    def info(self) -> dict:
+        snap = self.snap
+        if snap is None and self.metrics is not None:
+            snap = self.metrics.snapshot()
+        d = {
+            "id": self.id, "state": self.state, "rc": self.rc,
+            "input": self.in_path, "output": self.out_path,
+            "journal": self.journal_path, "error": self.error,
+            "attempts": self.attempts, "stop_reason": self.stop_reason,
+            "submitted_at": self.submitted_at,
+            "started_at": self.started_at,
+            "finished_at": self.finished_at,
+        }
+        if snap:
+            d["metrics"] = {k: snap.get(k) for k in (
+                "holes_in", "holes_out", "holes_failed", "holes_corrupt",
+                "holes_filtered", "device_hangs", "breaker_trips",
+                "host_fallbacks", "zmws_per_sec", "elapsed_s",
+                "degraded")}
+        return d
+
+
+class ServeCore:
+    """The resident server: one warm runtime, N tenant jobs.
+
+    Owns the process-global pieces exactly one owner may hold — the
+    installed tracer (ONE compile table across jobs: its group stats
+    accrue into ``self.metrics``, and "no group's compile count grows
+    after warmup" is the steady-state-recompile criterion), the
+    server-lifetime WarmupCompiler + inline-warm dedupe set, and the
+    FairWindow.  Jobs run on daemon threads (at most ``max_active``
+    concurrently) through run_pipeline_batched with a _JobRuntime.
+
+    The HTTP layer (_ServeHandler) is a thin client of this object;
+    tests drive ServeCore directly for the byte-identity and isolation
+    cases and through HTTP for the API cases."""
+
+    def __init__(self, cfg: CcsConfig, spool: str,
+                 max_queue: int = 16, max_active: int = 2,
+                 retries: int = 1, backoff_s: float = 0.5,
+                 job_deadline_s: float = 0.0):
+        from ccsx_tpu.utils import trace
+
+        self.cfg = cfg
+        self.spool = spool
+        os.makedirs(spool, exist_ok=True)
+        self.max_queue = max(1, int(max_queue))
+        self.max_active = max(1, int(max_active))
+        self.retries = max(0, int(retries))
+        self.backoff_s = max(0.0, float(backoff_s))
+        self.job_deadline_s = max(0.0, float(job_deadline_s))
+        self.metrics = Metrics(verbose=0, stream=None)
+        self._lock = threading.RLock()
+        self._persist_lock = threading.Lock()
+        self._jobs: Dict[str, Job] = {}
+        self._queue: List[Job] = []
+        self._seq = 0
+        self._n_running = 0
+        self.accepting = True
+        self.draining = False
+        self._completed_any = False
+        self._closed = False
+        # the server-lifetime warm plane (satellite: one sketch/screen/
+        # pair executable cache across jobs — WarmupCompiler dedupes on
+        # key, warm_cache dedupes the inline path)
+        self.warm = None
+        if getattr(cfg, "warmup_compile", True):
+            from ccsx_tpu.pipeline.warmup import WarmupCompiler
+
+            self.warm = WarmupCompiler()
+        self.warm_cache: set = set()
+        self.window = FairWindow(int(getattr(cfg, "zmw_microbatch", 64)))
+        # the server tracer: installed for the process lifetime, group
+        # table in self.metrics — /progress exposes the cumulative
+        # compile counters the zero-recompile test reads
+        self._tracer = trace.Tracer(None,
+                                    stall_timeout=cfg.stall_timeout_s,
+                                    metrics=self.metrics)
+        trace.install(self._tracer)
+        self._restore_state()
+        self._mon_stop = threading.Event()
+        self._mon = threading.Thread(target=self._monitor, daemon=True,
+                                     name="ccsx-serve-monitor")
+        self._mon.start()
+        self._pump()
+
+    # ---- submission -------------------------------------------------------
+
+    def submit(self, input_path: Optional[str] = None,
+               body_stream=None, body_len: int = 0,
+               overrides: Optional[dict] = None) -> Job:
+        overrides = dict(overrides or {})
+        unknown = [k for k in overrides
+                   if k not in _CFG_OVERRIDES and k not in _JOB_OVERRIDES]
+        if unknown:
+            raise ValueError(f"unknown job option(s): {unknown}")
+        with self._lock:
+            if not self.accepting:
+                raise Draining("server is draining")
+            queued = sum(1 for j in self._jobs.values()
+                         if j.state == "queued")
+            if queued >= self.max_queue:
+                raise QueueFull(
+                    f"job queue full ({queued}/{self.max_queue})")
+            self._seq += 1
+            jid = f"j{self._seq:04d}"
+        fmt = str(overrides.get("format") or "").lower()
+        if fmt and fmt not in ("bam", "fastq", "fasta"):
+            raise ValueError(f"unknown input format {fmt!r}")
+        if body_stream is not None:
+            # streamed submission: spool the body before the job exists
+            # (a torn upload must not leave a half-readable queued job)
+            suffix = fmt or "bam"
+            input_path = os.path.join(self.spool, f"{jid}.input.{suffix}")
+            with open(input_path, "wb") as f:
+                left = int(body_len)
+                while left > 0:
+                    chunk = body_stream.read(min(left, 1 << 16))
+                    if not chunk:
+                        raise ValueError("short request body")
+                    f.write(chunk)
+                    left -= len(chunk)
+        if not input_path:
+            raise ValueError("job needs an input path or a request body")
+        job = self._build_job(jid, input_path, overrides)
+        with self._lock:
+            self._jobs[jid] = job
+            self._queue.append(job)
+        self._persist()
+        self._pump()
+        return job
+
+    def _build_job(self, jid: str, input_path: str,
+                   overrides: dict) -> Job:
+        cfg_kw = {}
+        for key, (field, coerce) in _CFG_OVERRIDES.items():
+            if key in overrides and overrides[key] is not None:
+                try:
+                    cfg_kw[field] = coerce(overrides[key])
+                except (TypeError, ValueError):
+                    raise ValueError(f"bad value for {key!r}: "
+                                     f"{overrides[key]!r}")
+        fmt = str(overrides.get("format") or "").lower()
+        if fmt:
+            cfg_kw["is_bam"] = fmt == "bam"
+        # the job must not fight the server for process-global planes:
+        # no second telemetry server, no second metrics stream, no
+        # per-job trace file (the server tracer records every job)
+        cfg = dataclasses.replace(self.cfg, telemetry_port=0,
+                                  metrics_path=None, trace_path=None,
+                                  **cfg_kw)
+        out = str(overrides.get("output") or
+                  os.path.join(self.spool, f"{jid}.out.fasta"))
+        job = Job(jid, input_path, out,
+                  os.path.join(self.spool, f"{jid}.journal"), cfg,
+                  overrides=overrides)
+        job.deadline_s = float(overrides.get("deadline_s")
+                               or self.job_deadline_s or 0.0)
+        job.faults = overrides.get("faults") or None
+        if overrides.get("inflight") is not None:
+            job.inflight = int(overrides["inflight"])
+        return job
+
+    # ---- scheduling -------------------------------------------------------
+
+    def _pump(self) -> None:
+        with self._lock:
+            if self.draining:
+                return
+            while self._n_running < self.max_active and self._queue:
+                job = self._queue.pop(0)
+                if job.state != "queued":
+                    continue
+                job.state = "running"
+                if job.started_at is None:
+                    job.started_at = time.time()
+                self._n_running += 1
+                t = threading.Thread(target=self._job_main, args=(job,),
+                                     daemon=True,
+                                     name=f"ccsx-job-{job.id}")
+                job.thread = t
+                t.start()
+
+    def _job_main(self, job: Job) -> None:
+        try:
+            self._run_job(job)
+        finally:
+            with self._lock:
+                self._n_running -= 1
+            self._persist()
+            self._pump()
+
+    def _run_job(self, job: Job) -> None:
+        from ccsx_tpu.pipeline.batch import run_pipeline_batched
+
+        while True:
+            guard = FlagGuard()
+            with self._lock:
+                job.attempts += 1
+                job.guard = guard
+                if job.stop_reason:
+                    # a cancel/drain that raced the attempt start
+                    guard.request(job.stop_reason)
+            # the job's fault domain: its own spec (or an EMPTY scope —
+            # even a faultless job must be isolated from any
+            # server-level global plan)
+            token = faultinject.scope_arm(job.faults)
+            metrics = Metrics(verbose=0, stream=None)
+            metrics.job = job.id
+            job.metrics = metrics
+            adm = JobAdmission(self.window, job.id)
+            rt = _JobRuntime(self.warm, self.warm_cache, guard, adm)
+            rc: Optional[int] = None
+            try:
+                rc = run_pipeline_batched(
+                    job.in_path, job.out_path, job.cfg,
+                    journal_path=job.journal_path,
+                    inflight=job.inflight, metrics=metrics, shared=rt)
+            except SystemExit as e:  # argparse-style refusals downstream
+                rc = int(e.code or 0) or 1
+            except BaseException as e:
+                job.error = f"{type(e).__name__}: {e}"
+            finally:
+                adm.close()
+                faultinject.scope_reset(token)
+                job.snap = metrics.snapshot()
+            if rc == exitcodes.RC_OK:
+                self._finish(job, "done", rc)
+                return
+            if rc == exitcodes.RC_INTERRUPTED:
+                reason = job.stop_reason or guard.reason or "drain"
+                if reason == "cancel":
+                    self._finish(job, "cancelled", rc)
+                elif reason == "deadline":
+                    job.error = (f"job deadline "
+                                 f"({job.deadline_s:g}s) exceeded")
+                    self._finish(job, "failed", rc)
+                else:
+                    # server drain: journal settled, resumable by the
+                    # next server process
+                    self._finish(job, "interrupted", rc)
+                return
+            if rc == exitcodes.RC_FAILED_HOLES:
+                job.error = job.error or "failure budget exceeded"
+                self._finish(job, "failed", rc)
+                return
+            # rc 1 / unexpected exception: the transient class (ENOSPC,
+            # torn write, wedged backend refusal).  The journal makes a
+            # retry a RESUME — completed holes are not recomputed and
+            # the final bytes stay identical — so bounded
+            # retry-and-backoff is cheap and safe.
+            if job.attempts > self.retries or job.stop_reason:
+                job.error = job.error or f"rc {rc}"
+                self._finish(job, "failed",
+                             rc if rc is not None else 1)
+                return
+            delay = self.backoff_s * (2 ** (job.attempts - 1))
+            print(f"[ccsx-tpu] serve: job {job.id} attempt "
+                  f"{job.attempts} failed ({job.error or f'rc {rc}'}); "
+                  f"retrying in {delay:g}s", file=sys.stderr)
+            job.error = None
+            if job._stop_ev.wait(delay):
+                reason = job.stop_reason or "cancel"
+                if reason == "cancel":
+                    self._finish(job, "cancelled",
+                                 exitcodes.RC_INTERRUPTED)
+                elif reason == "drain":
+                    self._finish(job, "interrupted",
+                                 exitcodes.RC_INTERRUPTED)
+                else:
+                    job.error = (f"job deadline "
+                                 f"({job.deadline_s:g}s) exceeded")
+                    self._finish(job, "failed",
+                                 exitcodes.RC_INTERRUPTED)
+                return
+
+    def _finish(self, job: Job, state: str, rc: Optional[int]) -> None:
+        with self._lock:
+            job.state = state
+            job.rc = rc
+            job.finished_at = time.time()
+            if state == "done":
+                self._completed_any = True
+
+    # ---- control plane ----------------------------------------------------
+
+    def _signal_locked(self, job: Job, reason: str) -> None:
+        if not job.stop_reason:
+            job.stop_reason = reason
+        job._stop_ev.set()
+        if job.guard is not None:
+            job.guard.request(reason)
+
+    def cancel(self, jid: str):
+        """-> (state, changed).  KeyError for an unknown id."""
+        with self._lock:
+            job = self._jobs[jid]
+            if job.state in TERMINAL:
+                return job.state, False
+            if job.state == "queued":
+                if job in self._queue:
+                    self._queue.remove(job)
+                job.state = "cancelled"
+                job.rc = exitcodes.RC_INTERRUPTED
+                job.finished_at = time.time()
+            else:
+                self._signal_locked(job, "cancel")
+        self._persist()
+        return job.state, True
+
+    def _monitor(self) -> None:
+        # the deadline tick: --job-deadline (or a per-job deadline_s)
+        # bounds wall clock across attempts; exceeding it drains the
+        # job through its guard (journal settles — the operator can
+        # resubmit with a bigger deadline and it RESUMES)
+        while not self._mon_stop.wait(0.2):
+            now = time.time()
+            with self._lock:
+                for job in self._jobs.values():
+                    if (job.state == "running" and job.deadline_s > 0
+                            and job.started_at is not None
+                            and now - job.started_at > job.deadline_s
+                            and job.stop_reason is None):
+                        self._signal_locked(job, "deadline")
+
+    def drain(self, timeout: float = 600.0) -> int:
+        """SIGTERM semantics: stop accepting, drain running jobs
+        (their journals settle), persist the queue, report the exit
+        rc — 75 (resumable) when unfinished jobs remain, else 0."""
+        with self._lock:
+            self.accepting = False
+            self.draining = True
+            running = [j for j in self._jobs.values()
+                       if j.state == "running"]
+            for job in running:
+                self._signal_locked(job, "drain")
+        deadline = time.monotonic() + max(0.0, timeout)
+        for job in running:
+            t = job.thread
+            if t is not None:
+                t.join(max(0.1, deadline - time.monotonic()))
+        self._persist()
+        with self._lock:
+            resumable = any(j.state in ("queued", "running",
+                                        "interrupted")
+                            for j in self._jobs.values())
+        return exitcodes.RC_INTERRUPTED if resumable else exitcodes.RC_OK
+
+    def close(self) -> None:
+        from ccsx_tpu.utils import trace
+
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._mon_stop.set()
+        self._mon.join(timeout=5.0)
+        if self.warm is not None:
+            self.warm.close()
+        trace.uninstall()
+        self._tracer.close()
+
+    # ---- introspection ----------------------------------------------------
+
+    def job(self, jid: str) -> Optional[Job]:
+        with self._lock:
+            return self._jobs.get(jid)
+
+    def jobs(self) -> List[dict]:
+        with self._lock:
+            items = list(self._jobs.values())
+        return [j.info() for j in items]
+
+    def job_snapshots(self) -> Dict[str, dict]:
+        """job id -> Metrics snapshot, for the ccsx_job_* series."""
+        with self._lock:
+            items = list(self._jobs.items())
+        out = {}
+        for jid, job in items:
+            snap = job.snap
+            if snap is None and job.metrics is not None:
+                snap = job.metrics.snapshot()
+            if snap:
+                out[jid] = snap
+        return out
+
+    def counts(self) -> dict:
+        with self._lock:
+            c = {"jobs": len(self._jobs), "running": self._n_running,
+                 "queued": sum(1 for j in self._jobs.values()
+                               if j.state == "queued")}
+        return c
+
+    def wait(self, jid: str, timeout: float = 120.0) -> str:
+        """Block until the job reaches a terminal state (tests)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            job = self.job(jid)
+            if job is None:
+                raise KeyError(jid)
+            if job.state in TERMINAL:
+                return job.state
+            time.sleep(0.02)
+        return self.job(jid).state
+
+    def readiness(self):
+        """The /readyz hook: (ready, reason).  NOT tied to degraded —
+        a tenant-induced hang degrades that JOB to the host rung while
+        the server keeps taking traffic (the chaos-soak criterion)."""
+        with self._lock:
+            if self.draining:
+                return False, "draining"
+            queued = sum(1 for j in self._jobs.values()
+                         if j.state == "queued")
+            if queued >= self.max_queue:
+                return False, "queue full"
+            cold = not self._completed_any
+        if cold and self.warm is not None and self.warm.busy():
+            return False, "warming"
+        return True, "ok"
+
+    # ---- restart persistence ----------------------------------------------
+
+    def _persist(self) -> None:
+        with self._lock:
+            recs = []
+            for j in self._jobs.values():
+                recs.append({
+                    "id": j.id, "state": j.state, "rc": j.rc,
+                    "input": j.in_path, "output": j.out_path,
+                    "journal": j.journal_path, "error": j.error,
+                    "attempts": j.attempts,
+                    "overrides": j.raw_overrides,
+                    "submitted_at": j.submitted_at,
+                    "finished_at": j.finished_at,
+                })
+            state = {"version": 1, "seq": self._seq, "jobs": recs}
+        try:
+            # serialized: concurrent job threads persisting at once
+            # would race on the same .tmp sidecar
+            with self._persist_lock:
+                write_json_atomic(os.path.join(self.spool, STATE_FILE),
+                                  state)
+        except OSError as e:
+            print(f"[ccsx-tpu] serve: state persist failed: {e}",
+                  file=sys.stderr)
+
+    def _restore_state(self) -> None:
+        path = os.path.join(self.spool, STATE_FILE)
+        try:
+            with open(path, encoding="utf-8") as f:
+                state = json.load(f)
+        except (OSError, ValueError):
+            return
+        self._seq = int(state.get("seq") or 0)
+        for rec in state.get("jobs") or []:
+            try:
+                job = self._build_job(rec["id"], rec["input"],
+                                      rec.get("overrides") or {})
+            except (KeyError, ValueError):
+                continue
+            job.out_path = rec.get("output") or job.out_path
+            job.journal_path = rec.get("journal") or job.journal_path
+            job.rc = rec.get("rc")
+            job.error = rec.get("error")
+            job.attempts = int(rec.get("attempts") or 0)
+            job.submitted_at = rec.get("submitted_at") or time.time()
+            job.finished_at = rec.get("finished_at")
+            prev = rec.get("state")
+            if prev in ("done", "failed", "cancelled"):
+                job.state = prev  # history only
+            else:
+                # queued / running / interrupted at the old server's
+                # exit: requeue — the per-job journal resumes them to
+                # byte-identical outputs
+                job.state = "queued"
+                job.attempts = 0
+                job.finished_at = None
+            self._jobs[job.id] = job
+            if job.state == "queued":
+                self._queue.append(job)
+
+
+# ---- the HTTP layer -------------------------------------------------------
+
+def _serve_handler():
+    """Build the serve request handler lazily (keeps telemetry.py
+    import-light paths — stats/top — from importing this module)."""
+    from ccsx_tpu.utils import telemetry
+    from ccsx_tpu.utils.metrics import resource_gauges
+
+    class _ServeHandler(telemetry._Handler):
+        server_version = "ccsx-tpu-serve"
+
+        def _core(self) -> ServeCore:
+            return self.server.ccsx_core  # type: ignore[attr-defined]
+
+        def _send_json(self, code: int, obj, extra=None) -> None:
+            data = json.dumps(obj, default=str).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            for k, v in (extra or {}).items():
+                self.send_header(k, str(v))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _send_file(self, path: str) -> None:
+            try:
+                size = os.path.getsize(path)
+                f = open(path, "rb")
+            except OSError as e:
+                self._send_json(404, {"error": f"no output: {e}"})
+                return
+            with f:
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; charset=utf-8")
+                self.send_header("Content-Length", str(size))
+                self.end_headers()
+                while True:
+                    chunk = f.read(1 << 16)
+                    if not chunk:
+                        break
+                    self.wfile.write(chunk)
+
+        def do_GET(self):  # noqa: N802
+            core = self._core()
+            path, _, _q = self.path.partition("?")
+            try:
+                if path == "/healthz":
+                    # LIVENESS: answers "is the process serving?" —
+                    # always 200 while it is.  Per-job degradation
+                    # lives in /jobs/<id> and the ccsx_job_* series;
+                    # routability lives in /readyz.
+                    self._send_json(200, {"status": "alive",
+                                          **core.counts()})
+                elif path == "/metrics":
+                    body = telemetry.render_prometheus(
+                        core.metrics.snapshot(), resource_gauges())
+                    body += telemetry.render_job_series(
+                        core.job_snapshots())
+                    self._send(200, body,
+                               "text/plain; version=0.0.4; "
+                               "charset=utf-8")
+                elif path == "/jobs":
+                    self._send_json(200, {"jobs": core.jobs()})
+                elif path.startswith("/jobs/"):
+                    parts = path.split("/")
+                    job = core.job(parts[2])
+                    if job is None:
+                        self._send_json(404, {"error": "unknown job"})
+                    elif len(parts) == 3:
+                        self._send_json(200, job.info())
+                    elif len(parts) == 4 and parts[3] == "output":
+                        if job.state != "done":
+                            self._send_json(
+                                409, {"error": "job not done",
+                                      "state": job.state})
+                        else:
+                            self._send_file(job.out_path)
+                    else:
+                        self._send_json(404, {"error": "unknown path"})
+                else:
+                    super().do_GET()
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+
+        def do_POST(self):  # noqa: N802
+            core = self._core()
+            path, _, query = self.path.partition("?")
+            try:
+                if path != "/jobs":
+                    self._send_json(404, {"error": "unknown path"})
+                    return
+                import urllib.parse
+
+                params = {k: v[-1] for k, v in
+                          urllib.parse.parse_qs(query).items()}
+                length = int(self.headers.get("Content-Length") or 0)
+                ctype = (self.headers.get("Content-Type") or
+                         "").split(";")[0].strip().lower()
+                try:
+                    if ctype == "application/json":
+                        raw = self.rfile.read(length)
+                        body = json.loads(raw or b"{}")
+                        if not isinstance(body, dict):
+                            raise ValueError("JSON body must be an "
+                                             "object")
+                        params.update(body)
+                        input_path = params.pop("input", None)
+                        job = core.submit(input_path=input_path,
+                                          overrides=params)
+                    else:
+                        # streamed BAM/FASTQ body (?format=... names
+                        # the container; default bam)
+                        job = core.submit(body_stream=self.rfile,
+                                          body_len=length,
+                                          overrides=params)
+                except QueueFull as e:
+                    self._send_json(429, {"error": str(e)},
+                                    extra={"Retry-After": 5})
+                    return
+                except Draining as e:
+                    self._send_json(503, {"error": str(e)})
+                    return
+                except (ValueError, OSError) as e:
+                    self._send_json(400, {"error": str(e)})
+                    return
+                self._send_json(201, {"id": job.id,
+                                      "state": job.state,
+                                      "status": f"/jobs/{job.id}",
+                                      "output":
+                                      f"/jobs/{job.id}/output"})
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+
+        def do_DELETE(self):  # noqa: N802
+            core = self._core()
+            path, _, _q = self.path.partition("?")
+            try:
+                parts = path.split("/")
+                if len(parts) != 3 or parts[1] != "jobs":
+                    self._send_json(404, {"error": "unknown path"})
+                    return
+                try:
+                    state, changed = core.cancel(parts[2])
+                except KeyError:
+                    self._send_json(404, {"error": "unknown job"})
+                    return
+                self._send_json(200 if changed else 409,
+                                {"id": parts[2], "state": state,
+                                 "cancelled": changed})
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+
+    return _ServeHandler
+
+
+# ---- the subcommand -------------------------------------------------------
+
+def serve_main(argv) -> int:
+    """`ccsx-tpu serve`: parse serve flags, hand the rest to the
+    normal CLI parser for the compute config, run until SIGTERM."""
+    import argparse
+
+    from ccsx_tpu import cli
+    from ccsx_tpu.utils.drain import DrainGuard
+    from ccsx_tpu.utils import telemetry
+
+    ap = argparse.ArgumentParser(
+        prog="ccsx-tpu serve",
+        description="Resident multi-tenant consensus server: one warm "
+                    "runtime, per-job fault isolation, HTTP job API "
+                    "on the telemetry stack.  Unrecognized flags are "
+                    "the compute config (same flags as a plain run).")
+    ap.add_argument("--port", type=int, default=8855,
+                    help="HTTP port (auto-bumps when taken; 0 = one "
+                         "ephemeral port) [8855]")
+    ap.add_argument("--serve-host", default="",
+                    help="bind host [CCSX_TELEMETRY_HOST or 0.0.0.0]")
+    ap.add_argument("--spool", default=".ccsx_serve",
+                    help="spool directory: job inputs/outputs/journals "
+                         "+ state.json (restart resumes it) "
+                         "[.ccsx_serve]")
+    ap.add_argument("--max-queue", type=int, default=16,
+                    help="queued-job cap; submissions beyond it get "
+                         "HTTP 429 + Retry-After [16]")
+    ap.add_argument("--max-active", type=int, default=2,
+                    help="concurrently running jobs (they share the "
+                         "admission window fairly) [2]")
+    ap.add_argument("--job-retries", type=int, default=1,
+                    help="retry budget for transient (rc 1) job "
+                         "failures; retries RESUME from the job "
+                         "journal [1]")
+    ap.add_argument("--retry-backoff", type=float, default=0.5,
+                    help="base backoff seconds between retries "
+                         "(doubles per attempt) [0.5]")
+    ap.add_argument("--job-deadline", type=float, default=0.0,
+                    help="default per-job wall-clock deadline in "
+                         "seconds, across retries (0 = none; jobs can "
+                         "set their own deadline_s) [0]")
+    a, rest = ap.parse_known_args(argv)
+    cli_args = cli.build_parser().parse_args(rest)
+    if cli_args.help:
+        ap.print_help()
+        return 1
+    for flag, bad in (("--bam", cli_args.bam_out),
+                      ("--hosts", cli_args.hosts is not None),
+                      ("--fleet-dir", cli_args.fleet_dir is not None),
+                      ("--merge-shards",
+                       cli_args.merge_shards is not None),
+                      ("--make-index", cli_args.make_index)):
+        if bad:
+            print(f"Error: {flag} is not supported under serve",
+                  file=sys.stderr)
+            return 1
+    try:
+        cfg = cli.config_from_args(cli_args)
+    except SystemExit as e:
+        return int(e.code or 0)
+    if cli_args.inject_faults:
+        # server-level chaos plan (benchmarks/serve_chaos.py): fires
+        # only on threads OUTSIDE any job scope — jobs are isolated in
+        # their own (possibly empty) fault domains
+        try:
+            faultinject.arm(cli_args.inject_faults)
+        except ValueError as e:
+            print(f"Error: --inject-faults: {e}", file=sys.stderr)
+            return 1
+
+    guard = DrainGuard.install()
+    core = ServeCore(cfg, spool=a.spool, max_queue=a.max_queue,
+                     max_active=a.max_active, retries=a.job_retries,
+                     backoff_s=a.retry_backoff,
+                     job_deadline_s=a.job_deadline)
+    try:
+        srv = telemetry.TelemetryServer(
+            core.metrics, a.port, host=a.serve_host,
+            handler=_serve_handler(),
+            attrs={"ccsx_core": core, "ccsx_ready": core.readiness})
+    except OSError as e:
+        print(f"Error: serve: {e}", file=sys.stderr)
+        core.close()
+        guard.restore()
+        return 1
+    print(f"[ccsx-tpu] serve: http://{srv.host}:{srv.port} "
+          "(POST /jobs, GET /jobs/<id>, /readyz, /metrics; "
+          f"spool {a.spool})", file=sys.stderr)
+    try:
+        while not guard.requested:
+            time.sleep(0.2)
+        print("[ccsx-tpu] serve: draining — no new jobs, settling "
+              "in-flight journals (resumable rc 75)", file=sys.stderr)
+        rc = core.drain()
+    finally:
+        srv.close()
+        core.close()
+        guard.restore()
+    if rc == exitcodes.RC_INTERRUPTED:
+        print("[ccsx-tpu] serve: drained with unfinished jobs; "
+              "restart the same command to resume them",
+              file=sys.stderr)
+    return rc

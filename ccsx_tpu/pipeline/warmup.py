@@ -137,6 +137,16 @@ class WarmupCompiler:
                 return self._events[key]
             return None
 
+    def busy(self) -> bool:
+        """True while any accepted job is queued or building — the
+        serving plane's readiness probe (a cold server still compiling
+        its first tenant's executables reports ``ready: false`` so a
+        load balancer does not route a job storm into a compile
+        storm)."""
+        with self._cv:
+            return bool(self._queue) or ("running"
+                                         in self._state.values())
+
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Block until every accepted job has finished (benchmarks use
         this to warm synchronously before timing).  Returns False on

@@ -80,3 +80,29 @@ class DrainGuard:
                 signal.signal(sig, prev)
             except (ValueError, OSError):
                 pass
+
+
+class FlagGuard:
+    """A drain-guard surrogate for EMBEDDED drivers (the serving plane,
+    pipeline/serve.py): same ``.requested`` / ``.restore()`` surface as
+    DrainGuard, but raised by its owner — a job cancel (DELETE), the
+    job deadline, or a server-wide drain fanning out — instead of a
+    process signal.  Signal handlers belong to exactly one owner per
+    process; under ``ccsx-tpu serve`` that owner is the server's main
+    thread, and each job drains through one of these."""
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self.reason: str = ""
+
+    @property
+    def requested(self) -> bool:
+        return self._ev.is_set()
+
+    def request(self, reason: str = "") -> None:
+        if reason and not self.reason:
+            self.reason = reason
+        self._ev.set()
+
+    def restore(self) -> None:  # no handlers to restore
+        pass

@@ -70,10 +70,24 @@ Points and their actions (each placed at ONE spot in the pipeline):
 
 The hard exits use ``os._exit`` (no atexit, no finally blocks, writer
 not closed) to model SIGKILL as closely as a same-process mechanism can.
+
+**Scoped arming (the serving plane's per-job fault domain)**: a
+resident `ccsx-tpu serve` process runs many jobs concurrently in one
+address space, so the global plan above would fire on whichever
+tenant's thread reaches the point first.  ``scope_arm(spec)`` instead
+arms a plan carried by a ``contextvars.ContextVar``: it applies to the
+calling thread and to every thread whose target was wrapped with
+``inherit()`` at spawn (the deadline runner and the prep pool do this —
+contextvars do NOT cross ``threading.Thread`` by default).  While a
+scope is set — even an empty one — the global plan is ignored for that
+thread family: a job's fault domain is exactly its own spec, and
+server-side faults can never leak into a tenant.  Threads outside any
+scope (the warmup pool, the HTTP server) keep the global-plan behavior.
 """
 
 from __future__ import annotations
 
+import contextvars
 import os
 import threading
 from typing import Dict, Optional
@@ -95,6 +109,50 @@ _calls: Dict[str, int] = {}
 # a pool): the call counter must be atomic or an @N schedule can be
 # skipped under a racy read-modify-write
 _lock = threading.Lock()
+
+# the per-context (per-job) fault domain; None = use the global plan
+_scope_var: "contextvars.ContextVar[Optional[Scope]]" = \
+    contextvars.ContextVar("ccsx_fault_scope", default=None)
+
+
+class Scope:
+    """One fault domain: a plan plus its own call counters, so two
+    jobs arming the same point@N spec each see their own schedule."""
+
+    def __init__(self, spec: Optional[str]):
+        self.plan = parse_spec(spec) if spec else None
+        self.calls: Dict[str, int] = {}
+        self.lock = threading.Lock()
+
+
+def scope_arm(spec: Optional[str]):
+    """Arm ``spec`` for the current context (and for threads spawned
+    through ``inherit()``-wrapped targets).  A falsy spec arms an EMPTY
+    domain — the caller is isolated from the global plan but fires
+    nothing.  Returns a token for ``scope_reset``."""
+    return _scope_var.set(Scope(spec))
+
+
+def scope_reset(token) -> None:
+    _scope_var.reset(token)
+
+
+def current_scope() -> Optional[Scope]:
+    return _scope_var.get()
+
+
+def inherit(fn):
+    """Wrap a thread target so the new thread runs in a COPY of the
+    spawning thread's context (carrying its fault scope): plain
+    ``threading.Thread`` starts every target in a fresh context, which
+    would silently drop a job's fault domain at the first pool or
+    deadline-runner hop."""
+    ctx = contextvars.copy_context()
+
+    def _run(*args, **kwargs):
+        return ctx.run(fn, *args, **kwargs)
+
+    return _run
 
 
 def parse_spec(spec: str) -> dict:
@@ -133,10 +191,15 @@ def disarm() -> None:
 
 
 def armed(point: Optional[str] = None) -> bool:
-    _ensure_init()
-    if _plan is None:
+    scope = _scope_var.get()
+    if scope is not None:
+        plan = scope.plan
+    else:
+        _ensure_init()
+        plan = _plan
+    if plan is None:
         return False
-    return point in _plan if point else bool(_plan)
+    return point in plan if point else bool(plan)
 
 
 def _ensure_init() -> None:
@@ -158,13 +221,19 @@ def _ensure_init() -> None:
 def fire(point: str) -> None:
     """Injection point hook: a no-op unless this point is armed and its
     schedule says this call is the one.  Raises/exits per the point's
-    documented action."""
-    _ensure_init()
-    if _plan is None or point not in _plan:
+    documented action.  A thread carrying a fault scope consults ONLY
+    that scope's plan and counters (its job's fault domain)."""
+    scope = _scope_var.get()
+    if scope is not None:
+        plan, calls, lock = scope.plan, scope.calls, scope.lock
+    else:
+        _ensure_init()
+        plan, calls, lock = _plan, _calls, _lock
+    if plan is None or point not in plan:
         return
-    with _lock:
-        _calls[point] = n = _calls.get(point, 0) + 1
-    fire_at, repeat = _plan[point]
+    with lock:
+        calls[point] = n = calls.get(point, 0) + 1
+    fire_at, repeat = plan[point]
     if n != fire_at and not (repeat and n >= fire_at):
         return
     import sys

@@ -110,6 +110,12 @@ def check_failure_budget(metrics: "Metrics", cfg, final: bool = False):
 class Metrics:
     verbose: int = 0
     stream: Optional[TextIO] = None
+    # multi-tenant label (pipeline/serve.py): the job id this Metrics
+    # object accounts for.  None outside the serving plane.  Rides
+    # every snapshot/event so a job's JSONL stream and its
+    # ccsx_job_*{job="..."} series are attributable without relying on
+    # file paths.
+    job: Optional[str] = None
     holes_in: int = 0
     holes_out: int = 0
     holes_failed: int = 0
@@ -552,6 +558,8 @@ class Metrics:
                        for st in dict(self.group_stats).values())
             snap["compile_s"] = round(comp, 4)
             snap["compile_share"] = round(comp / self.elapsed, 4)
+        if self.job:
+            snap["job"] = self.job
         if self.degraded:
             snap["degraded"] = self.degraded
         # degraded-relevant detail: a FAILED native .so auto-rebuild

@@ -122,6 +122,17 @@ HEALTH_DETAIL_KEYS = ("stalls", "oom_resplits", "host_fallbacks",
                       "compile_fallbacks",
                       "refine_overflows", "device_hangs",
                       "breaker_trips", "breaker_state")
+# per-job labeled series the serving plane (pipeline/serve.py) exports
+# as ccsx_job_<key>{job="..."} from each job's own Metrics snapshot —
+# the fault-domain counters an operator triages a tenant by.  Schema-
+# guarded like the tuples above (tests/test_serve.py cross-checks them
+# against a populated snapshot).
+JOB_PROM_COUNTERS = (
+    "holes_in", "holes_out", "holes_failed", "holes_filtered",
+    "holes_corrupt", "device_hangs", "breaker_trips", "oom_resplits",
+    "host_fallbacks",
+)
+JOB_PROM_GAUGES = ("zmws_per_sec", "elapsed_s")
 
 
 # ---- Prometheus text rendering --------------------------------------------
@@ -211,6 +222,35 @@ def health_payload(snap: dict) -> dict:
     }
 
 
+def render_job_series(jobs: dict) -> str:
+    """Per-job labeled Prometheus series for the serving plane:
+    ``jobs`` maps job id -> that job's ``Metrics.snapshot()``.  Every
+    family is declared once (TYPE line) then sampled per job — the
+    multi-tenant view of the same counters render_prometheus exports
+    for a single run."""
+    lines: List[str] = []
+    typed: set = set()
+
+    def sample(name, value, typ, labels):
+        v = _num(value)
+        if v is None:
+            return
+        if name not in typed:
+            typed.add(name)
+            lines.append(f"# TYPE ccsx_job_{name} {typ}")
+        lines.append(f"ccsx_job_{name}{labels} {v}")
+
+    for jid, snap in sorted(jobs.items()):
+        labels = f'{{job="{_prom_escape(jid)}"}}'
+        for key in JOB_PROM_COUNTERS:
+            sample(key, (snap or {}).get(key), "counter", labels)
+        for key in JOB_PROM_GAUGES:
+            sample(key, (snap or {}).get(key), "gauge", labels)
+        if (snap or {}).get("degraded"):
+            sample("degraded", 1, "gauge", labels)
+    return ("\n".join(lines) + "\n") if lines else ""
+
+
 # ---- the endpoint server --------------------------------------------------
 
 class _Handler(BaseHTTPRequestHandler):
@@ -239,6 +279,23 @@ class _Handler(BaseHTTPRequestHandler):
                 h = health_payload(metrics.snapshot())
                 self._send(200 if h["status"] == "ok" else 503,
                            json.dumps(h), "application/json")
+            elif path == "/readyz":
+                # liveness-vs-readiness split: /readyz answers "route
+                # traffic here?" — the serving plane hangs its warmup/
+                # drain state on ``ccsx_ready`` (a () -> (bool, reason)
+                # attribute on the server); a plain run's readiness is
+                # its health (degraded = do not route)
+                ready_fn = getattr(self.server, "ccsx_ready", None)
+                if ready_fn is not None:
+                    ready, reason = ready_fn()
+                else:
+                    snap = metrics.snapshot()
+                    ready = not snap.get("degraded")
+                    reason = snap.get("degraded")
+                self._send(200 if ready else 503,
+                           json.dumps({"ready": bool(ready),
+                                       "reason": reason}),
+                           "application/json")
             elif path in ("/progress", "/"):
                 snap = metrics.snapshot()
                 snap["status"] = ("degraded" if snap.get("degraded")
@@ -248,7 +305,7 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._send(404, json.dumps(
                     {"error": "unknown path", "paths":
-                     ["/metrics", "/healthz", "/progress"]}),
+                     ["/metrics", "/healthz", "/readyz", "/progress"]}),
                     "application/json")
         except (BrokenPipeError, ConnectionResetError):
             pass  # scraper went away mid-response
@@ -273,17 +330,23 @@ class TelemetryServer:
     which degrades to a warning — telemetry must never kill a run).
     """
 
-    def __init__(self, metrics: Metrics, port: int, host: str = ""):
+    def __init__(self, metrics: Metrics, port: int, host: str = "",
+                 handler=None, attrs: Optional[dict] = None):
         self.host = host or os.environ.get("CCSX_TELEMETRY_HOST",
                                            "0.0.0.0")
         err: Optional[Exception] = None
         self._srv = None
         # clamp the probe window to valid ports: a rank-offset base near
         # the top (distributed.py adds rank) must degrade, not crash —
-        # socket raises OverflowError (not OSError) past 65535
-        for p in range(min(port, 65536), min(port + PORT_TRIES, 65536)):
+        # socket raises OverflowError (not OSError) past 65535.
+        # ``handler``/``attrs`` are the serving plane's extension point
+        # (pipeline/serve.py mounts its job API on this same stack);
+        # port 0 binds one ephemeral port, for embedded/test servers.
+        handler = handler or _Handler
+        for p in range(min(port, 65536),
+                       min(max(port + PORT_TRIES, 1), 65536)):
             try:
-                self._srv = _Server((self.host, p), _Handler)
+                self._srv = _Server((self.host, p), handler)
                 break
             except (OSError, OverflowError) as e:
                 err = e
@@ -292,6 +355,8 @@ class TelemetryServer:
                 f"telemetry: no free port in [{port}, "
                 f"{min(port + PORT_TRIES, 65536)}): {err}")
         self._srv.ccsx_metrics = metrics  # type: ignore[attr-defined]
+        for k, v in (attrs or {}).items():
+            setattr(self._srv, k, v)
         self.port = self._srv.server_address[1]
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         name="ccsx-telemetry",
@@ -319,7 +384,7 @@ def start(metrics: Metrics, port: int) -> Optional[TelemetryServer]:
         print(f"[ccsx-tpu] telemetry disabled: {e}", file=sys.stderr)
         return None
     print(f"[ccsx-tpu] telemetry: http://{srv.host}:{srv.port} "
-          "(/metrics /healthz /progress)", file=sys.stderr)
+          "(/metrics /healthz /readyz /progress)", file=sys.stderr)
     return srv
 
 

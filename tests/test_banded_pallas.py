@@ -303,6 +303,9 @@ def _compare3(qs, qlens, ts, tlens, params, with_stats=True):
                 err_msg=f"{name}: moves mismatch, problem {i}")
 
 
+@pytest.mark.slow  # ~27s: three interpret-mode arms; the tier-1 pins
+# are rotband_slim_and_gblock (rotband vs scan) + bit_exact_random_batch
+# (v1 vs scan), and the 256-wide edge sweep covers all three in slow
 def test_rotband_three_way_bit_exact():
     """The tier-1 slice of the three-way fuzz: scan vs Pallas v1 vs
     rotband v2 on a small random batch, full-stats mode (the slim mode

@@ -269,6 +269,9 @@ def test_pair_executor_warm_api(rng):
 # ---- CLI plumbing ----------------------------------------------------------
 
 
+@pytest.mark.slow  # ~15s warmup-on/off CLI A/B (r15 budget audit);
+# tier-1 keeps the compile-budget guard (test_compile_budget_scale64)
+# and the WarmupCompiler unit pins
 def test_cli_no_warmup_and_ladder_flags(tmp_path, rng):
     """--no-warmup and --slab-shape-ladder reach the config, and a
     ladder-1 run (every slab full height) stays byte-identical — the

@@ -38,6 +38,9 @@ def test_shard_stream_partition():
     assert shards[2] == [2, 5, 8]
 
 
+@pytest.mark.slow  # ~18s 4-shard A/B (r15 budget audit); tier-1 keeps
+# the mesh-sharded merge==single-host pin below and the real
+# two-process coordinator run
 def test_sharded_run_merge_equals_single_host(tmp_path, rng):
     """N sequential 'hosts' + merge == the single-process batched output."""
     zs, fa = _make_inputs(tmp_path, rng, n_holes=7)

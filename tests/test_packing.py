@@ -137,6 +137,10 @@ def test_packed_oom_bisects_by_hole_then_replays_on_host(rng):
         faultinject.disarm()
 
 
+@pytest.mark.slow  # ~20s three-arm CLI A/B (r15 budget audit); tier-1
+# keeps the executor-level packed==bucketed pins in test_batch.py
+# (packed_transfer_protocol, executor_matches_per_hole) and the CLI
+# batched==per-hole pin (test_cli_batched_equals_per_hole)
 def test_cli_packed_equals_bucketed_equals_per_hole(tmp_path, rng):
     """The tentpole acceptance pin on a mixed-pass synth corpus: the
     packed default, the --pass-buckets bucketed control, and the

@@ -208,6 +208,9 @@ def _run_cli_subprocess(args, env_extra):
                           timeout=300)
 
 
+@pytest.mark.slow  # ~20s subprocess kill+resume A/B (r15 budget
+# audit); tier-1 keeps the kill/resume pins in test_faults.py and the
+# serve drain/restart resume in test_serve.py
 def test_kill_and_resume_with_prep_threads(corpus, tmp_path):
     """Kill-and-resume with the pool ON: the write-fault hard kill
     leaves a torn tail, and a --journal resume (prep threads still on)

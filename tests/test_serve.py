@@ -1,0 +1,374 @@
+"""Serving plane (pipeline/serve.py): resident multi-tenant server.
+
+Load-bearing guarantees pinned here:
+
+* N concurrent jobs through one warm ServeCore produce outputs
+  BYTE-IDENTICAL to the sequential CLI run of the same input, and a
+  second wave of jobs books ZERO new XLA compiles in the server
+  tracer's group table (the steady-state-recompile criterion).
+* The queue-depth cap answers HTTP 429 with a Retry-After header.
+* DELETE cancels a mid-flight job through the drivers' drain path
+  (rc 75) without touching its siblings.
+* A server drain with in-flight work exits resumable (rc 75,
+  "interrupted"), and a restarted core requeues the job from
+  state.json and completes it byte-identically via its journal.
+* A tenant-induced device hang degrades ONLY that job to the host
+  rung: the faulted job completes byte-identically with its own
+  device_hangs/host_fallbacks counters, the clean sibling shows none,
+  and the server stays ready throughout.
+* /healthz is LIVENESS (200 while serving) and /readyz is READINESS
+  (503 + reason while draining); the per-job Prometheus series
+  conforms to the telemetry schema tuples.
+
+The corpus reuses the 700 bp / 5-pass geometry of tests/test_faults.py
+and tests/test_resilience.py so tier-1's process-wide jit cache is
+shared across the three files.
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ccsx_tpu import cli, exitcodes
+from ccsx_tpu.pipeline.serve import (FairWindow, ServeCore, QueueFull,
+                                     _serve_handler)
+from ccsx_tpu.utils import faultinject, synth, telemetry
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.disarm()
+    yield
+    faultinject.disarm()
+
+
+@pytest.fixture(autouse=True)
+def _fast_grace(monkeypatch):
+    # unit-scale budgets: no 10x first-of-shape deadline grace, bounded
+    # hang parks, short injected stalls
+    monkeypatch.setenv("CCSX_DEADLINE_GRACE", "1")
+    monkeypatch.setenv("CCSX_FAULT_HANG_S", "60")
+    monkeypatch.setenv("CCSX_FAULT_STALL_S", "4")
+
+
+def _cfg(extra=()):
+    args = cli.build_parser().parse_args(["-A", "-m", "1000", *extra])
+    return cli.config_from_args(args)
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    """(3-hole input, its CLI reference output, 8-hole input, its CLI
+    reference output) — references computed by the plain CLI BEFORE
+    any ServeCore exists (the server owns the installed tracer)."""
+    tmp = tmp_path_factory.mktemp("serve")
+    rng = np.random.default_rng(0)
+
+    def make(n, path):
+        zs = [synth.make_zmw(rng, template_len=700, n_passes=5,
+                             movie="mv", hole=str(100 + h))
+              for h in range(n)]
+        path.write_text(synth.make_fasta(zs))
+
+    fa3, fa8 = tmp / "in3.fa", tmp / "in8.fa"
+    make(3, fa3)
+    make(8, fa8)
+    ref3, ref8 = tmp / "ref3.fa", tmp / "ref8.fa"
+    assert cli.main(["-A", "-m", "1000", "--batch", "on",
+                     str(fa3), str(ref3)]) == 0
+    assert cli.main(["-A", "-m", "1000", "--batch", "on",
+                     str(fa8), str(ref8)]) == 0
+    return (str(fa3), ref3.read_bytes(), str(fa8), ref8.read_bytes())
+
+
+@pytest.fixture
+def core_factory(tmp_path):
+    cores = []
+
+    def make(spool="spool", extra=(), **kw):
+        c = ServeCore(_cfg(extra), spool=str(tmp_path / spool), **kw)
+        cores.append(c)
+        return c
+
+    yield make
+    for c in cores:
+        c.close()
+
+
+def _http(srv):
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def req(method, path, data=None, ctype="application/json"):
+        r = urllib.request.Request(base + path, data=data, method=method)
+        if data is not None:
+            r.add_header("Content-Type", ctype)
+        try:
+            with urllib.request.urlopen(r, timeout=30) as resp:
+                return resp.status, resp.read(), dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, e.read(), dict(e.headers)
+
+    return req
+
+
+@pytest.fixture
+def served(core_factory):
+    """(core, req) — a ServeCore mounted on an ephemeral-port HTTP
+    server through the telemetry stack, torn down after the test."""
+    servers = []
+
+    def make(**kw):
+        core = core_factory(**kw)
+        srv = telemetry.TelemetryServer(
+            core.metrics, 0, host="127.0.0.1",
+            handler=_serve_handler(),
+            attrs={"ccsx_core": core, "ccsx_ready": core.readiness})
+        servers.append(srv)
+        return core, _http(srv)
+
+    yield make
+    for s in servers:
+        s.close()
+
+
+# ---------- units: the fair shared admission window ----------
+
+def test_fair_window_semantics():
+    w = FairWindow(4)
+    w.register("a")
+    # a lone tenant gets the whole window
+    assert all(w.try_acquire("a") for _ in range(4))
+    assert not w.try_acquire("a")          # capacity, not share
+    # a second tenant arrives and is denied (window full): it is now
+    # "wanting", so the incumbent is capped at its fair share
+    # (ceil(4/2) = 2) until the newcomer gets a slot
+    w.register("b")
+    assert not w.try_acquire("b")
+    w.release("a")                         # a holds 3: above its share
+    assert not w.try_acquire("a")          # capped while b wants
+    assert w.try_acquire("b")              # the freed slot goes to b
+    # b's success clears its "wanting" mark: nobody is being starved,
+    # so a may grow back into whatever capacity is free
+    w.release("a")
+    w.release("a")                         # a holds 1, b holds 1
+    assert w.try_acquire("a") and w.try_acquire("a")
+    # b leaves: the lone tenant may take the whole window again
+    w.release_all("b")
+    w.unregister("b")
+    assert w.try_acquire("a")
+    assert not w.try_acquire("a")          # back at capacity (4)
+    w.release_all("a")
+    w.unregister("a")
+
+
+# ---------- concurrency: byte identity + zero steady-state compiles --------
+
+def test_concurrent_jobs_byte_identical_no_recompiles(corpus,
+                                                      core_factory):
+    fa3, ref3, _, _ = corpus
+    core = core_factory(max_active=3)
+    first = [core.submit(input_path=fa3) for _ in range(3)]
+    for j in first:
+        assert core.wait(j.id, 180) == "done", (j.state, j.error)
+        assert open(j.out_path, "rb").read() == ref3
+
+    def compiles():
+        groups = core.metrics.snapshot().get("groups") or {}
+        return sum(g["compiles"] for g in groups.values())
+
+    warm = compiles()
+    # steady state: a second concurrent wave books ZERO new compiles
+    # in the server tracer's cumulative group table
+    second = [core.submit(input_path=fa3) for _ in range(3)]
+    for j in second:
+        assert core.wait(j.id, 180) == "done", (j.state, j.error)
+        assert open(j.out_path, "rb").read() == ref3
+    assert compiles() == warm, "steady-state serve run recompiled"
+    # per-job fault-domain accounting stayed per job
+    snaps = core.job_snapshots()
+    assert all(snaps[j.id]["job"] == j.id for j in first + second)
+    assert all(snaps[j.id]["holes_out"] == 3 for j in first + second)
+
+
+# ---------- the HTTP job API ----------
+
+def test_queue_cap_429_with_retry_after(corpus, served):
+    fa3, ref3, _, _ = corpus
+    core, req = served(max_active=1, max_queue=1)
+    # occupy the one runner with a stalled job, fill the one queue slot
+    code, body, _ = req("POST", "/jobs", json.dumps(
+        {"input": fa3, "faults": "stall@1"}).encode())
+    assert code == 201
+    held = json.loads(body)["id"]
+    code, body, _ = req("POST", "/jobs",
+                        json.dumps({"input": fa3}).encode())
+    assert code == 201
+    queued = json.loads(body)["id"]
+    # the cap: 429 + Retry-After, and /readyz flips to "queue full"
+    code, body, headers = req("POST", "/jobs",
+                              json.dumps({"input": fa3}).encode())
+    assert code == 429
+    assert int(headers.get("Retry-After", 0)) >= 1
+    code, body, _ = req("GET", "/readyz")
+    assert code == 503 and json.loads(body)["reason"] == "queue full"
+    # liveness is unaffected by a full queue
+    code, body, _ = req("GET", "/healthz")
+    assert code == 200 and json.loads(body)["status"] == "alive"
+    # the held jobs still complete byte-identically
+    for jid in (held, queued):
+        assert core.wait(jid, 180) == "done"
+        assert open(core.job(jid).out_path, "rb").read() == ref3
+    code, body, _ = req("GET", "/readyz")
+    assert code == 200
+
+
+def test_submit_validation(served):
+    _, req = served()
+    code, body, _ = req("POST", "/jobs", json.dumps(
+        {"input": "/nonexistent", "bogus_knob": 1}).encode())
+    assert code == 400 and b"bogus_knob" in body
+    code, body, _ = req("POST", "/jobs", b"{}")
+    assert code == 400
+    code, body, _ = req("GET", "/jobs/zzz")
+    assert code == 404
+
+
+def test_cancel_mid_job_leaves_sibling_untouched(corpus, served):
+    fa3, ref3, _, _ = corpus
+    core, req = served(max_active=2)
+    code, body, _ = req("POST", "/jobs", json.dumps(
+        {"input": fa3, "faults": "stall@1"}).encode())
+    victim = json.loads(body)["id"]
+    code, body, _ = req("POST", "/jobs",
+                        json.dumps({"input": fa3}).encode())
+    sibling = json.loads(body)["id"]
+    time.sleep(0.5)  # stall@1 holds the victim mid-flight
+    code, body, _ = req("DELETE", f"/jobs/{victim}")
+    assert code == 200 and json.loads(body)["cancelled"]
+    assert core.wait(victim, 60) == "cancelled"
+    assert core.job(victim).rc == exitcodes.RC_INTERRUPTED
+    # cancelling again is a no-op conflict, not an error
+    code, body, _ = req("DELETE", f"/jobs/{victim}")
+    assert code == 409
+    # blast radius: the sibling is untouched
+    assert core.wait(sibling, 180) == "done"
+    assert open(core.job(sibling).out_path, "rb").read() == ref3
+
+
+# ---------- drain + restart resume ----------
+
+def test_drain_rc75_and_restart_resumes_byte_identical(corpus, tmp_path):
+    _, _, fa8, ref8 = corpus
+    spool = str(tmp_path / "spool")
+    core = ServeCore(_cfg(), spool=spool, max_active=1)
+    try:
+        # inflight=1 bounds ingest-ahead to 4 holes, so a drain during
+        # the stalled first dispatch leaves real work for the resume
+        j = core.submit(input_path=fa8,
+                        overrides={"faults": "stall@1", "inflight": 1})
+        time.sleep(0.8)  # mid-flight inside the stalled dispatch
+        rc = core.drain(timeout=120)
+        assert rc == exitcodes.RC_INTERRUPTED
+        job = core.job(j.id)
+        assert job.state == "interrupted"
+        assert job.rc == exitcodes.RC_INTERRUPTED
+        # the drain settled a PARTIAL journal (the resume has work)
+        done = json.loads(open(job.journal_path).read())["holes_done"]
+        assert 0 < done < 8
+    finally:
+        core.close()
+    # restart: the job requeues from state.json and resumes from its
+    # journal to the byte-identical output
+    core2 = ServeCore(_cfg(), spool=spool, max_active=1)
+    try:
+        assert core2.wait(j.id, 180) == "done"
+        assert open(core2.job(j.id).out_path, "rb").read() == ref8
+    finally:
+        core2.close()
+
+
+# ---------- per-job fault isolation ----------
+
+def test_device_hang_degrades_only_the_faulted_job(corpus, served):
+    fa3, ref3, _, _ = corpus
+    core, req = served(max_active=2)
+    # tenant A wedges its first dispatch; its own 1.5 s dispatch
+    # deadline abandons the call and replays on the host rung
+    bad = core.submit(input_path=fa3, overrides={
+        "faults": "device_hang@1", "dispatch_deadline_s": 1.5})
+    good = core.submit(input_path=fa3)
+    assert core.wait(good.id, 180) == "done"
+    assert core.wait(bad.id, 180) == "done", (bad.state, bad.error)
+    # both byte-identical (the host path is the bit-exact spec)
+    assert open(bad.out_path, "rb").read() == ref3
+    assert open(good.out_path, "rb").read() == ref3
+    # the fault domain: the hang + fallback booked ONLY in A
+    snaps = core.job_snapshots()
+    assert snaps[bad.id]["device_hangs"] >= 1
+    assert snaps[bad.id]["host_fallbacks"] >= 1
+    assert snaps[good.id]["device_hangs"] == 0
+    assert snaps[good.id]["host_fallbacks"] == 0
+    # the server stayed routable the whole time
+    code, body, _ = req("GET", "/readyz")
+    assert code == 200
+    # and the per-job series carries the isolation story: the faulted
+    # tenant's hang counter moved, the clean tenant's sits at 0
+    code, body, _ = req("GET", "/metrics")
+    text = body.decode()
+    assert f'ccsx_job_device_hangs{{job="{good.id}"}} 0' in text
+    bad_line = f'ccsx_job_device_hangs{{job="{bad.id}"}}'
+    assert bad_line in text
+    assert f"{bad_line} 0" not in text
+
+
+# ---------- liveness/readiness split + schema ----------
+
+def test_liveness_vs_readiness_split(served):
+    core, req = served()
+    code, body, _ = req("GET", "/healthz")
+    assert code == 200 and json.loads(body)["status"] == "alive"
+    code, body, _ = req("GET", "/readyz")
+    assert code == 200 and json.loads(body)["ready"] is True
+    assert core.drain(timeout=10) == exitcodes.RC_OK  # idle drain
+    code, body, _ = req("GET", "/healthz")
+    assert code == 200  # liveness survives the drain
+    code, body, _ = req("GET", "/readyz")
+    assert code == 503 and json.loads(body)["reason"] == "draining"
+    code, body, _ = req("POST", "/jobs", b"{}")
+    assert code == 503  # draining refuses new jobs
+
+
+def test_job_prom_schema_matches_snapshot(corpus, core_factory):
+    fa3, _, _, _ = corpus
+    core = core_factory()
+    j = core.submit(input_path=fa3)
+    assert core.wait(j.id, 180) == "done"
+    snap = core.job_snapshots()[j.id]
+    # both directions: every schema key exists in a populated snapshot,
+    # and the rendered series carries every family for this job
+    missing = [k for k in (telemetry.JOB_PROM_COUNTERS
+                           + telemetry.JOB_PROM_GAUGES) if k not in snap]
+    assert not missing, f"schema keys absent from snapshot: {missing}"
+    text = telemetry.render_job_series({j.id: snap})
+    for key in telemetry.JOB_PROM_COUNTERS:
+        assert f'ccsx_job_{key}{{job="{j.id}"}}' in text
+    assert f'# TYPE ccsx_job_holes_out counter' in text
+
+
+def test_queue_full_core_raises(corpus, core_factory, monkeypatch):
+    fa3, _, _, _ = corpus
+    monkeypatch.setenv("CCSX_FAULT_STALL_S", "2")
+    core = core_factory(max_active=1, max_queue=1)
+    held = core.submit(input_path=fa3, overrides={"faults": "stall@1"})
+    queued = core.submit(input_path=fa3)
+    with pytest.raises(QueueFull):
+        core.submit(input_path=fa3)
+    # settle before teardown: close() must not rip the warm plane out
+    # from under running job threads
+    for j in (held, queued):
+        core.wait(j.id, 180)
