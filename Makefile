@@ -4,7 +4,7 @@
 PY ?= python
 PYTEST_FLAGS = -q -p no:cacheprovider -p no:xdist -p no:randomly
 
-.PHONY: chaos chaos-soak fleet-chaos serve-chaos fuzz fuzz-sweep tier1 native long-molecule pallas-ab
+.PHONY: chaos chaos-soak fleet-chaos serve-chaos serve-fleet-chaos fuzz fuzz-sweep tier1 tier1-shard native long-molecule pallas-ab
 
 # the long-template (ultra-long-read) A/B: prefilter + device seeding
 # vs the legacy host path, interleaved arms, bytes asserted identical
@@ -53,6 +53,17 @@ serve-chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serve.py $(PYTEST_FLAGS)
 	JAX_PLATFORMS=cpu $(PY) benchmarks/serve_chaos.py --seed 0 --holes 6
 
+# the replica-fleet plane: the deterministic tier-1 slice (tests/
+# test_lease.py crash-consistency + tests/test_serve_fleet.py:
+# cross-replica handoff, dead-replica requeue, exclusive retirement,
+# gateway routing, fan-out) then the seeded 3-replica subprocess soak —
+# SIGKILL mid-wave, mid-run join, SIGTERM drain — against the
+# zero-lost/zero-duplicate/byte-identity oracle (also directly:
+# python benchmarks/serve_fleet_chaos.py --seed N)
+serve-fleet-chaos:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_lease.py tests/test_serve_fleet.py -m 'not slow' $(PYTEST_FLAGS)
+	JAX_PLATFORMS=cpu $(PY) benchmarks/serve_fleet_chaos.py --seed 0
+
 # the full randomized soak (also available directly:
 # python benchmarks/chaos.py --seed N --trials T)
 chaos-soak:
@@ -69,6 +80,13 @@ pallas-ab:
 # the ROADMAP tier-1 suite (same flags as the verify command)
 tier1:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -m 'not slow' --continue-on-collection-errors $(PYTEST_FLAGS)
+
+# tier-1 split across N workers pulling per-file leases through the
+# r16 lease domain (utils/lease.py + exclusive done markers): same
+# suite, 1/N-ish the wall clock, crash-safe work handoff
+N ?= 2
+tier1-shard:
+	$(PY) benchmarks/tier1_shard.py --workers $(N)
 
 native:
 	$(MAKE) -C ccsx_tpu/native

@@ -274,6 +274,92 @@ def compare_serve(line, prev, vp, regressed):
             "server throughput regression)")
 
 
+def latest_serve_fleet_artifacts(root=_HERE, n=2):
+    """The ``n`` highest-numbered usable benchmarks/serve_fleet_r*.json
+    artifacts (the replica-fleet churn soak,
+    benchmarks/serve_fleet_chaos.py), newest first, as (name, summary)
+    pairs.  Usable = carries the steady fleet record (sustained zmws/s
+    across the replica fleet plus the per-replica steady-state
+    recompile total); the summary also keeps the job-accounting
+    verdicts (lost / duplicated / byte identity)."""
+    import glob
+    import re
+
+    cands = []
+    for p in glob.glob(os.path.join(root, "benchmarks",
+                                    "serve_fleet_r*.json")):
+        m = re.search(r"serve_fleet_r(\d+)\.json$", p)
+        if m:
+            cands.append((int(m.group(1)), p))
+    out = []
+    for _, p in sorted(cands, reverse=True):
+        try:
+            with open(p) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            continue
+        steady = d.get("steady") or {}
+        if steady.get("zmws_per_sec") is None:
+            continue
+        out.append((os.path.basename(p),
+                    {"zmws_per_sec": steady["zmws_per_sec"],
+                     "recompiles": steady.get("recompiles"),
+                     "lost_jobs": d.get("lost_jobs"),
+                     "duplicated_jobs": d.get("duplicated_jobs"),
+                     "byte_identical": d.get("byte_identical"),
+                     "ok": d.get("ok")}))
+        if len(out) >= n:
+            break
+    return out
+
+
+def compare_serve_fleet(line, prev, vp, regressed):
+    """The replica-fleet leg of the vs_prev gate: sustained fleet-wide
+    zmws/s under replica churn (SIGKILL mid-wave + mid-run join) from
+    the newest serve_fleet_r*.json artifact vs the prior bench line's
+    (or the second-newest artifact).  A >20% relative drop trips
+    ``regressed`` — and so, OUTRIGHT, does any lost or duplicated job,
+    any non-byte-identical output, any failed trial, or a nonzero
+    per-replica steady-state recompile count: a fleet that loses jobs
+    under churn (or double-emits them past the exclusive retirement
+    fence) has lost the whole point of the lease domain."""
+    arts = latest_serve_fleet_artifacts()
+    if arts:
+        name, summary = arts[0]
+        line["serve_fleet"] = {"artifact": name, **summary}
+        if summary.get("ok") is False:
+            regressed.append(
+                f"serve-fleet soak {name} has failed trials")
+        if summary.get("lost_jobs") or summary.get("duplicated_jobs"):
+            regressed.append(
+                f"serve-fleet soak {name} lost "
+                f"{summary.get('lost_jobs')} / duplicated "
+                f"{summary.get('duplicated_jobs')} job(s) under churn "
+                "(the zero-lost-jobs invariant broke)")
+        if summary.get("byte_identical") is False:
+            regressed.append(
+                f"serve-fleet soak {name} produced non-byte-identical "
+                "job outputs")
+        if summary.get("recompiles"):
+            regressed.append(
+                f"serve-fleet soak {name} booked "
+                f"{summary['recompiles']} steady-state recompiles "
+                "across its replicas (warm residency broke)")
+    cur = (line.get("serve_fleet") or {}).get("zmws_per_sec")
+    prev_s = ((prev or {}).get("serve_fleet") or {}).get("zmws_per_sec")
+    prev_src = "prev bench line"
+    if prev_s is None and len(arts) > 1:
+        prev_src, prev_s = arts[1][0], arts[1][1]["zmws_per_sec"]
+    if cur is None or prev_s is None:
+        return
+    vp["serve_fleet_zmws_per_sec"] = {"prev": prev_s, "cur": cur,
+                                      "prev_source": prev_src}
+    if prev_s > 0 and cur < prev_s * REGRESSION_DROP:
+        regressed.append(
+            f"serve-fleet steady zmws_per_sec {prev_s}->{cur} "
+            "(fleet throughput regression under churn)")
+
+
 def latest_pallas_ab_artifacts(root=_HERE, n=2):
     """The ``n`` highest-numbered usable benchmarks/pallas_ab*_r*.json
     artifacts (the scan / Pallas v1 / rotband v2 promotion harness,
@@ -477,6 +563,7 @@ def compare_with_prev(line, prev, artifact):
     compare_quality(line, prev, vp, regressed)
     compare_fleet(line, prev, vp, regressed)
     compare_serve(line, prev, vp, regressed)
+    compare_serve_fleet(line, prev, vp, regressed)
     compare_dp_kernel(line, prev, vp, regressed)
     line["vs_prev"] = vp
     if regressed:
@@ -825,6 +912,7 @@ def _inner_main():
         compare_quality(line, None, vp, regressed)
         compare_fleet(line, None, vp, regressed)
         compare_serve(line, None, vp, regressed)
+        compare_serve_fleet(line, None, vp, regressed)
         compare_dp_kernel(line, None, vp, regressed)
         line["vs_prev"] = vp
         if regressed:

@@ -1,0 +1,379 @@
+"""Replica-fleet churn soak: N warm servers, one spool, zero lost jobs.
+
+The r16 fleet's claim (pipeline/serve.py fleet mode +
+pipeline/gateway.py spool protocol) is that the SPOOL, not any
+replica, owns the jobs: every queued job is leased with the same
+audited O_EXCL + heartbeat + kill-before-steal machinery as the PR 13
+range queue, and completed work is fenced by an exclusive done marker.
+Replica churn must therefore cost availability only — never a job,
+never a duplicate emission, never a byte.
+
+This soak drives a real 3-replica subprocess fleet through:
+
+  warm wave     W small jobs through the gateway -> all done,
+                byte-identical, per-replica compile tables recorded
+  churn wave    W small jobs + 1 fan-out job (>= --fanout-holes, split
+                through the range queue across replicas); one replica
+                is SIGKILLed mid-wave while holding job leases, and a
+                4th replica JOINS mid-run.  Every job must end done
+                with EXACTLY one done marker; the killed replica's
+                leased jobs must be completed by survivors;
+                every output byte-identical to the solo CLI reference
+  steady wave   W jobs timed across the surviving fleet -> sustained
+                fleet zmws/s (the number bench.py's SERVE-FLEET leg
+                gates with the 20% rule) and ZERO new compiles summed
+                over every live replica's /metrics group table
+  drain         SIGTERM fans out; every replica exits rc 0/75 with
+                its leases released
+
+Schedules are pure functions of ``--seed`` (replayable); the corpus
+builder and reference runner are benchmarks/chaos.py's.  The fast
+deterministic slices of this story are tier-1
+(tests/test_serve_fleet.py, tests/test_lease.py); this soak is the
+composition proof:
+
+    python benchmarks/serve_fleet_chaos.py --seed 0 \
+        --json benchmarks/serve_fleet_rNN.json   (`make serve-fleet-chaos`)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["CCSX_JOURNAL_FSYNC_S"] = "0"
+os.environ["CCSX_DEADLINE_GRACE"] = "1"
+
+import numpy as np                                            # noqa: E402
+
+from ccsx_tpu import exitcodes                                # noqa: E402
+from ccsx_tpu.pipeline import gateway as spoolproto           # noqa: E402
+from ccsx_tpu.utils import lease as leaselib                  # noqa: E402
+from benchmarks.chaos import make_corpus, run_reference       # noqa: E402
+
+# the replica runner: backend-pinned like the shepherd's children
+# (accelerator plugins can override JAX_PLATFORMS at import time)
+_PRELUDE = "import jax; jax.config.update('jax_platforms', 'cpu'); "
+_RUNNER = ("import sys; from ccsx_tpu.cli import main; "
+           "sys.exit(main(sys.argv[1:]))")
+
+
+def _spawn_replica(spool: str, name: str, base_port: int,
+                   fanout_holes: int, fanout_ranges: int, log_dir: str,
+                   lease_timeout: float):
+    # the lease timeout must tolerate heartbeat stalls from CPU
+    # oversubscription (N replicas warming on few cores) — too tight
+    # and kill-before-steal turns contention into fratricide
+    cmd = [sys.executable, "-c", _PRELUDE + _RUNNER, "serve",
+           "--fleet", spool, "-A", "-m", "1000",
+           "--port", str(base_port), "--replica-name", name,
+           "--lease-timeout", str(lease_timeout), "--poll", "0.1",
+           "--fanout-holes", str(fanout_holes),
+           "--fanout-ranges", str(fanout_ranges),
+           "--max-active", "2"]
+    log = open(os.path.join(log_dir, f"{name}.log"), "ab")
+    proc = subprocess.Popen(cmd, env=dict(os.environ), stdout=log,
+                            stderr=subprocess.STDOUT)
+    return proc, log
+
+
+def _probe_ready(rep: dict) -> bool:
+    """Live readiness by actually asking the replica (a SIGKILLed
+    replica's stale slot lease still LOOKS ready for one timeout)."""
+    if not rep.get("port"):
+        return False
+    url = f"http://{rep['addr']}:{rep['port']}/readyz"
+    try:
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return bool(json.loads(resp.read() or b"{}").get("ready"))
+    except (OSError, ValueError):
+        return False
+
+
+def _wait_ready(spool: str, want: int, timeout: float = 600.0) -> list:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        reps = [r for r in spoolproto.discover_replicas(spool)
+                if _probe_ready(r)]
+        if len(reps) >= want:
+            return reps
+        time.sleep(0.5)
+    raise RuntimeError(
+        f"fleet never reached {want} ready replicas: "
+        f"{spoolproto.discover_replicas(spool)}")
+
+
+def _scrape_compiles(spool: str) -> dict:
+    """{replica_name: summed ccsx_group_compiles} over every live
+    replica's /metrics — the per-replica steady-state recompile
+    ledger."""
+    out = {}
+    for r in spoolproto.discover_replicas(spool):
+        url = f"http://{r['addr']}:{r['port']}/metrics"
+        try:
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                text = resp.read().decode()
+        except (OSError, ValueError):
+            continue
+        total = 0
+        for ln in text.splitlines():
+            if ln.startswith("ccsx_group_compiles{"):
+                try:
+                    total += int(float(ln.rsplit(None, 1)[1]))
+                except (IndexError, ValueError):
+                    pass
+        out[r["name"]] = total
+    return out
+
+
+def _submit_wave(gw, in_fa: str, n: int) -> list:
+    return [gw.submit(input_path=in_fa) for _ in range(n)]
+
+
+def _wait_jobs(spool: str, jids: list, timeout: float = 900.0) -> dict:
+    views = {}
+    deadline = time.monotonic() + timeout
+    pending = set(jids)
+    while pending and time.monotonic() < deadline:
+        for jid in sorted(pending):
+            v = spoolproto.job_view(spool, jid)
+            if v and v["state"] in ("done", "failed", "cancelled",
+                                    "interrupted"):
+                views[jid] = v
+                pending.discard(jid)
+        time.sleep(0.2)
+    for jid in pending:
+        views[jid] = spoolproto.job_view(spool, jid)  # lost / stuck
+    return views
+
+
+def _bytes(path) -> bytes:
+    try:
+        return open(path, "rb").read()
+    except (OSError, TypeError):
+        return b""
+
+
+def _marker_count(spool: str, jid: str) -> int:
+    return sum(1 for n in os.listdir(spool)
+               if n == f"done.{jid}.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--holes", type=int, default=6)
+    ap.add_argument("--big-holes", type=int, default=10,
+                    help="fan-out job size (>= --fanout-holes) [10]")
+    ap.add_argument("--fanout-holes", type=int, default=8)
+    ap.add_argument("--fanout-ranges", type=int, default=3)
+    ap.add_argument("--wave-jobs", type=int, default=4)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--lease-timeout", type=float, default=30.0,
+                    help="replica job-lease heartbeat timeout; sized "
+                         "for CPU-oversubscribed soak boxes [30]")
+    ap.add_argument("--base-port", type=int, default=8901)
+    ap.add_argument("--json", default=None,
+                    help="write the artifact here "
+                         "(benchmarks/serve_fleet_rNN.json)")
+    a = ap.parse_args(argv)
+    rng = np.random.default_rng(a.seed)
+    t_start = time.time()
+    trials = []
+    procs = {}
+    logs = []
+
+    with tempfile.TemporaryDirectory() as tmp:
+        small_fa = make_corpus(tmp, rng, a.holes)
+        ref_small = run_reference(small_fa, tmp)
+        big_dir = os.path.join(tmp, "big")
+        os.makedirs(big_dir)
+        big_fa = make_corpus(big_dir, rng, a.big_holes)
+        ref_big = run_reference(big_fa, big_dir)
+        spool = os.path.join(tmp, "spool")
+        os.makedirs(spool)
+
+        def spawn(name):
+            procs[name] = _spawn_replica(
+                spool, name, a.base_port, a.fanout_holes,
+                a.fanout_ranges, tmp, a.lease_timeout)
+            logs.append(procs[name][1])
+
+        try:
+            for k in range(a.replicas):
+                spawn(f"r{k}")
+            _wait_ready(spool, a.replicas)
+            gw = spoolproto.Gateway(spool, max_queue=64, probe_s=0.2)
+
+            # ---- warm wave ----
+            jids = _submit_wave(gw, small_fa, a.wave_jobs)
+            views = _wait_jobs(spool, jids)
+            ident = [_bytes((views[j] or {}).get("output")) == ref_small
+                     for j in jids]
+            warm_compiles = _scrape_compiles(spool)
+            t = {"kind": "warm_wave", "jobs": len(jids),
+                 "states": [(views[j] or {}).get("state") for j in jids],
+                 "identical": ident,
+                 "compiles": warm_compiles,
+                 "ok": all((views[j] or {}).get("state") == "done"
+                           for j in jids) and all(ident)}
+            trials.append(t)
+
+            # ---- churn wave: SIGKILL mid-wave + mid-run join ----
+            jids = _submit_wave(gw, small_fa, a.wave_jobs)
+            big = gw.submit(input_path=big_fa)
+            jids.append(big)
+            # the victim is the first replica OBSERVED holding a job
+            # lease — the kill always lands with work genuinely in
+            # flight, never on an idle bystander
+            pid_to_name = {p.pid: name
+                           for name, (p, _) in procs.items()}
+            vic_pid, held = None, []
+            deadline = time.monotonic() + 120
+            while not held and time.monotonic() < deadline:
+                for k, rec in leaselib.list_leases(spool):
+                    pid = (rec or {}).get("pid")
+                    if k.startswith("j") and pid in pid_to_name:
+                        vic_pid = pid
+                        held = [k2 for k2, r2
+                                in leaselib.list_leases(spool)
+                                if r2 and r2.get("pid") == vic_pid
+                                and k2.startswith("j")]
+                        break
+                time.sleep(0.05)
+            if vic_pid is None:
+                raise RuntimeError("no replica ever held a job lease")
+            victim = pid_to_name[vic_pid]
+            os.kill(vic_pid, signal.SIGKILL)
+            procs[victim][0].wait(timeout=30)
+            # a 4th replica joins the running fleet mid-churn
+            joiner = f"r{a.replicas}"
+            spawn(joiner)
+            views = _wait_jobs(spool, jids)
+            lost = [j for j in jids
+                    if not views[j]
+                    or views[j]["state"] not in ("done",)]
+            dup = [j for j in jids if _marker_count(spool, j) != 1]
+            ident = [_bytes((views[j] or {}).get("output"))
+                     == (ref_big if j == big else ref_small)
+                     for j in jids]
+            stolen = {j: (views[j] or {}).get("replica") for j in held}
+            t = {"kind": "churn_wave", "jobs": len(jids),
+                 "killed": victim, "killed_pid": vic_pid,
+                 "killed_held_leases": held, "joined": joiner,
+                 "fanout_job": big,
+                 "completed_by": {j: (views[j] or {}).get("replica")
+                                  for j in jids},
+                 "lost": lost, "duplicated": dup, "identical": ident,
+                 "ok": (not lost and not dup and all(ident)
+                        and bool(held)
+                        and all(r and r != victim
+                                for r in stolen.values()))}
+            trials.append(t)
+
+            # ---- rewarm: saturate every survivor (incl. the joiner)
+            # so the steady wave's zero-recompile claim covers the
+            # WHOLE fleet.  2*max_active*survivors jobs exceed the two
+            # incumbents' capacity, forcing work onto the joiner.
+            _wait_ready(spool, a.replicas)       # joiner up, victim out
+            jids = _submit_wave(gw, small_fa, 2 * a.replicas)
+            views = _wait_jobs(spool, jids)
+            rewarm_ok = all((views[j] or {}).get("state") == "done"
+                            for j in jids)
+            trials.append({"kind": "rewarm", "jobs": len(jids),
+                           "by": sorted({(views[j] or {}).get("replica")
+                                         for j in jids}),
+                           "ok": rewarm_ok})
+
+            # ---- steady wave: sustained fleet rate, zero compiles ----
+            pre = _scrape_compiles(spool)
+            t0 = time.monotonic()
+            jids = _submit_wave(gw, small_fa, a.wave_jobs)
+            views = _wait_jobs(spool, jids)
+            wall = time.monotonic() - t0
+            post = _scrape_compiles(spool)
+            recompiles = sum(post.get(r, 0) - pre.get(r, 0)
+                             for r in post)
+            ident = [_bytes((views[j] or {}).get("output")) == ref_small
+                     for j in jids]
+            steady = {"kind": "steady_wave", "jobs": a.wave_jobs,
+                      "wall_s": round(wall, 2),
+                      "zmws_per_sec":
+                      round(a.wave_jobs * a.holes / wall, 3),
+                      "recompiles": recompiles,
+                      "per_replica_compiles": post,
+                      "ok": (all((views[j] or {}).get("state") == "done"
+                                 for j in jids)
+                             and all(ident) and recompiles == 0)}
+            trials.append(steady)
+
+            # ---- drain: SIGTERM fans out, leases released ----
+            for name, (p, _) in procs.items():
+                if p.poll() is None:
+                    p.send_signal(signal.SIGTERM)
+            rcs = {}
+            for name, (p, _) in procs.items():
+                if name == victim:
+                    continue
+                try:
+                    rcs[name] = p.wait(timeout=120)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    rcs[name] = "hung"
+            job_leases = [k for k, _ in leaselib.list_leases(spool)
+                          if k.startswith("j")]
+            t = {"kind": "drain", "rcs": rcs,
+                 "job_leases_left": job_leases,
+                 "ok": (all(rc in (0, exitcodes.RC_INTERRUPTED)
+                            for rc in rcs.values())
+                        and not job_leases)}
+            trials.append(t)
+        finally:
+            for name, (p, _) in procs.items():
+                if p.poll() is None:
+                    p.kill()
+                    try:
+                        p.wait(timeout=10)
+                    except (OSError, subprocess.TimeoutExpired):
+                        pass
+            for log in logs:
+                try:
+                    log.close()
+                except OSError:
+                    pass
+
+    churn = next(t for t in trials if t["kind"] == "churn_wave")
+    n_failed = sum(1 for t in trials if not t.get("ok"))
+    out = {"seed": a.seed, "holes": a.holes,
+           "big_holes": a.big_holes, "replicas": a.replicas,
+           "steady": next(t for t in trials
+                          if t["kind"] == "steady_wave"),
+           "lost_jobs": len(churn["lost"]),
+           "duplicated_jobs": len(churn["duplicated"]),
+           "byte_identical": all(
+               all(t.get("identical", [True]))
+               for t in trials if "identical" in t),
+           "trials": trials, "n_trials": len(trials),
+           "n_failed": n_failed, "ok": n_failed == 0,
+           "elapsed_s": round(time.time() - t_start, 1)}
+    blob = json.dumps(out, indent=1)
+    print(blob)
+    if a.json:
+        with open(a.json, "w") as f:
+            f.write(blob)
+    return 0 if n_failed == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,147 @@
+"""Sharded tier-1 runner: the repo's own test suite as a lease domain.
+
+`make tier1` runs tests/ serially inside the 870 s ROADMAP budget.
+This runner splits the wall clock across K workers using the SAME
+machinery r16 ships for serve jobs (utils/lease.py + the exclusive
+done-marker fence): every test FILE is a leasable work unit in a
+shared domain directory, each worker pulls the next free file with the
+kernel-arbitrated O_EXCL acquire, runs pytest on just that file, and
+retires it with write_json_exclusive — so a crashed worker's file is
+re-runnable (its lease expires), two workers can never double-run a
+file, and the domain directory doubles as the result ledger.
+
+Workers here are processes on one box (`make tier1-shard N=4`), but
+the domain is just a directory: point --dir at a shared filesystem and
+start the runner on several boxes for a cross-machine shard, exactly
+like `serve --fleet`.
+
+    python benchmarks/tier1_shard.py --workers 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from ccsx_tpu.utils import lease as leaselib                  # noqa: E402
+from ccsx_tpu.utils.journal import write_json_exclusive       # noqa: E402
+
+PYTEST_FLAGS = ["-q", "-m", "not slow", "-p", "no:cacheprovider",
+                "-p", "no:xdist", "-p", "no:randomly"]
+# pytest rc 5 = "no tests collected" — a file whose every test is
+# deselected by `-m 'not slow'` is a pass, not a failure
+OK_RCS = (0, 5)
+
+
+def test_files(tests_dir: str):
+    return sorted(os.path.basename(p)
+                  for p in glob.glob(os.path.join(tests_dir, "test_*.py")))
+
+
+def _marker(d: str, key: str) -> str:
+    return os.path.join(d, f"done.{key}.json")
+
+
+def run_worker(d: str, tests_dir: str, worker: str,
+               lease_timeout: float = 600.0) -> None:
+    """Pull file leases until the domain is drained.  One full sweep
+    with no acquirable free file ends the worker (files leased by a
+    LIVE sibling are its problem; files with markers are done)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    while True:
+        progressed = pending = False
+        for key in test_files(tests_dir):
+            if os.path.exists(_marker(d, key)):
+                continue
+            # a crashed sibling's lease frees after lease_timeout (no
+            # kill: its pytest child died with it)
+            leaselib.expire_lease(d, key, lease_timeout, kill=False)
+            rec = leaselib.try_acquire(d, key, worker)
+            if rec is None:
+                pending = True                   # leased by a sibling
+                continue
+            t0 = time.monotonic()
+            proc = subprocess.run(
+                [sys.executable, "-m", "pytest",
+                 os.path.join(tests_dir, key)] + PYTEST_FLAGS,
+                env=env, cwd=_REPO, capture_output=True, text=True)
+            write_json_exclusive(_marker(d, key), {
+                "file": key, "rc": proc.returncode, "worker": worker,
+                "elapsed_s": round(time.monotonic() - t0, 1),
+                "tail": proc.stdout[-2000:]})
+            leaselib.release(d, key, rec)
+            progressed = True
+        if not progressed and not pending:
+            return
+        if not progressed:
+            time.sleep(1.0)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workers", "-n", type=int, default=2,
+                    help="worker processes pulling file leases [2]")
+    ap.add_argument("--dir", default=None,
+                    help="shared lease-domain directory (default: a "
+                         "fresh temp dir; set it to a shared mount to "
+                         "shard across machines)")
+    ap.add_argument("--tests", default=os.path.join(_REPO, "tests"))
+    ap.add_argument("--worker-name", default=None,
+                    help=argparse.SUPPRESS)   # internal: child mode
+    a = ap.parse_args(argv)
+
+    if a.worker_name:                         # child: pull until drained
+        run_worker(a.dir, a.tests, a.worker_name)
+        return 0
+
+    own_tmp = None
+    d = a.dir
+    if d is None:
+        own_tmp = tempfile.TemporaryDirectory(prefix="tier1_shard.")
+        d = own_tmp.name
+    os.makedirs(d, exist_ok=True)
+    t0 = time.monotonic()
+    kids = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--dir", d,
+         "--tests", a.tests, "--worker-name", f"w{k}"])
+        for k in range(max(1, a.workers))]
+    for p in kids:
+        p.wait()
+    wall = time.monotonic() - t0
+
+    results = []
+    for key in test_files(a.tests):
+        try:
+            with open(_marker(d, key)) as f:
+                results.append(json.load(f))
+        except (OSError, ValueError):
+            results.append({"file": key, "rc": None, "worker": None})
+    bad = [r for r in results if r["rc"] not in OK_RCS]
+    serial = sum(r.get("elapsed_s") or 0 for r in results)
+    for r in sorted(results, key=lambda r: -(r.get("elapsed_s") or 0)):
+        mark = "ok " if r["rc"] in OK_RCS else "FAIL"
+        print(f"  {mark} {r['file']:<36} {r.get('elapsed_s') or '?':>7}s"
+              f"  [{r.get('worker')}]")
+    print(f"tier1-shard: {len(results) - len(bad)}/{len(results)} files"
+          f" ok, {a.workers} workers, wall {wall:.0f}s"
+          f" (serial-equivalent {serial:.0f}s)")
+    for r in bad:
+        print(f"  FAILED {r['file']} rc={r['rc']}\n{r.get('tail', '')}",
+              file=sys.stderr)
+    if own_tmp:
+        own_tmp.cleanup()
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

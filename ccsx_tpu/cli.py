@@ -158,7 +158,18 @@ ccsx-tpu shepherd --hosts N [opts] <INPUT> <OUTPUT>
                            stale leases expire after --lease-timeout
                            (SIGKILL + requeue), and
                            `shepherd --join <out>.fleet --hosts K`
-                           adds K workers to a running fleet mid-run)
+                           adds K workers to a running fleet mid-run.
+                           With --serve-replicas N [--gateway-port P]
+                           the shepherd supervises a SERVE fleet
+                           instead: N `serve --fleet` replicas + the
+                           gateway as children — crashes restart with
+                           backoff up to --max-replica-restarts, a
+                           drained replica (rc 0/75) is not restarted
+                           (its spool jobs stay with the survivors),
+                           SIGTERM fans out a bounded-grace drain;
+                           flags after the shepherd's own are the
+                           serve/compute flags, e.g. `shepherd
+                           --serve-replicas 3 --fleet SPOOL -A`)
 ccsx-tpu stats <jsonl>... (summarize --trace / --metrics artifacts:
                            shape-group attribution table, stage
                            breakdown, occupancy recap, slowest
@@ -189,7 +200,26 @@ ccsx-tpu serve [opts]     (resident multi-tenant consensus server:
                            resumable rc 75 and a restart requeues
                            unfinished jobs from <spool>/state.json.
                            Compute flags after the serve flags are
-                           the normal run options)
+                           the normal run options.
+                           With --fleet <spool> the server is one
+                           REPLICA of a fleet sharing <spool> as a
+                           job lease domain: jobs are leased
+                           (O_EXCL acquire, heartbeat renew,
+                           exclusive done marker), replica death
+                           requeues them to survivors, jobs with
+                           >= --fanout-holes holes fan out across
+                           replicas through the range queue, and
+                           each replica serves on port+slot)
+ccsx-tpu gateway --spool S (thin balancer over a serve fleet: POST
+                           /jobs health-routed on replica /readyz
+                           — 503 + Retry-After when all drain, 429
+                           at the spool cap — fleet job API served
+                           from the spool, /replicas discovery from
+                           slot leases, and ccsx_fleet_* autoscale
+                           gauges — spool depth, leases held, per-
+                           replica admission-window pressure — on
+                           /metrics; no jax: keeps routing while
+                           every replica's accelerator is wedged)
 """
 
 
@@ -635,6 +665,13 @@ def main(argv: Optional[list] = None) -> int:
         from ccsx_tpu.pipeline.serve import serve_main
 
         return serve_main(argv[1:])
+    if argv and argv[0] == "gateway":
+        # serve-fleet balancer/aggregator (pipeline/gateway.py) — the
+        # same no-jax discipline as stats/top: it must keep routing
+        # while every replica's accelerator is wedged
+        from ccsx_tpu.pipeline.gateway import gateway_main
+
+        return gateway_main(argv[1:])
     args = build_parser().parse_args(argv)
     if args.help:
         return usage()  # rc 1, like the reference (main.c:761)

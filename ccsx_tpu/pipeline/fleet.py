@@ -46,7 +46,6 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-import signal
 import sys
 import threading
 import time
@@ -55,11 +54,12 @@ from typing import List, Optional, Tuple
 from ccsx_tpu import exitcodes
 from ccsx_tpu.config import CcsConfig
 from ccsx_tpu.parallel import distributed
+from ccsx_tpu.utils import lease as leaselib
 from ccsx_tpu.utils.journal import Journal, write_json_atomic
 from ccsx_tpu.utils.metrics import Metrics
 
 FLEET_STATE = "fleet.json"
-GRAVEYARD = "expired"
+GRAVEYARD = leaselib.GRAVEYARD
 
 
 # ---------- fleet state (the range table) ----------
@@ -121,21 +121,21 @@ def load_fleet(d: str) -> Optional[dict]:
 
 
 # ---------- lease primitives ----------
+#
+# The state machine itself lives in utils/lease.py (factored out in
+# PR 16 so serve jobs and shard ranges share one audited primitive);
+# these wrappers pin the fleet plane's integer-keyed API and its
+# on-disk layout (``lease.<i>``, owner records carrying ``range``)
+# exactly as PR 13 shipped them.
 
 def lease_path(d: str, i: int) -> str:
-    return os.path.join(d, f"lease.{i}")
+    return leaselib.lease_path(d, str(i))
 
 
 def read_lease(d: str, i: int) -> Optional[dict]:
     """The lease's owner record, {} for a torn lease (crash between
     O_EXCL create and the owner write), None when free."""
-    try:
-        with open(lease_path(d, i)) as f:
-            return json.load(f)
-    except FileNotFoundError:
-        return None
-    except (OSError, ValueError):
-        return {}
+    return leaselib.read_lease(d, str(i))
 
 
 def try_acquire(d: str, i: int, worker: str) -> Optional[dict]:
@@ -145,20 +145,7 @@ def try_acquire(d: str, i: int, worker: str) -> Optional[dict]:
     pid, heartbeat) is fsynced into the fresh file; a SIGKILL between
     create and write leaves a TORN lease, which the scheduler ages by
     file mtime and expires like any stale one."""
-    try:
-        fd = os.open(lease_path(d, i),
-                     os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
-    except FileExistsError:
-        return None
-    now = time.time()
-    rec = {"range": i, "worker": worker, "pid": os.getpid(),
-           "acquired": now, "renewed": now}
-    try:
-        os.write(fd, json.dumps(rec).encode())
-        os.fsync(fd)
-    finally:
-        os.close(fd)
-    return rec
+    return leaselib.try_acquire(d, str(i), worker, extra={"range": i})
 
 
 def renew(d: str, i: int, rec: dict) -> bool:
@@ -169,28 +156,14 @@ def renew(d: str, i: int, rec: dict) -> bool:
     function: the scheduler SIGKILLs a local holder before renaming its
     lease away, so a holder that can still run this code has not been
     stolen from."""
-    cur = read_lease(d, i)
-    if (not cur or cur.get("worker") != rec["worker"]
-            or cur.get("pid") != rec["pid"]):
-        return False
-    try:
-        write_json_atomic(lease_path(d, i), dict(rec, renewed=time.time()))
-    except OSError:
-        return False
-    return True
+    return leaselib.renew(d, str(i), rec)
 
 
 def release(d: str, i: int, rec: dict) -> None:
     """Free the lease (after the done marker is durable, or on drain).
     Losing a steal race (FileNotFoundError) is fine — released is
     released."""
-    cur = read_lease(d, i)
-    if (cur and cur.get("worker") == rec["worker"]
-            and cur.get("pid") == rec["pid"]):
-        try:
-            os.unlink(lease_path(d, i))
-        except OSError:
-            pass
+    leaselib.release(d, str(i), rec)
 
 
 def steal_lease(d: str, i: int, cur: dict, kill: bool = True,
@@ -201,24 +174,7 @@ def steal_lease(d: str, i: int, cur: dict, kill: bool = True,
     past our read would otherwise clobber the next owner).  The rename
     into the graveyard is atomic; losing the rename race means someone
     else already freed it — not an error."""
-    pid = cur.get("pid")
-    if kill and pid and int(pid) != os.getpid():
-        try:
-            os.kill(int(pid), signal.SIGKILL)
-        except (OSError, ValueError):
-            pass   # already gone (or never ours to kill)
-    grave = os.path.join(d, GRAVEYARD)
-    os.makedirs(grave, exist_ok=True)
-    dst = os.path.join(grave, f"lease.{i}.{os.getpid()}.{seq}")
-    k = 0
-    while os.path.exists(dst):
-        k += 1
-        dst = os.path.join(grave, f"lease.{i}.{os.getpid()}.{seq}~{k}")
-    try:
-        os.replace(lease_path(d, i), dst)
-    except OSError:
-        return None
-    return cur
+    return leaselib.steal_lease(d, str(i), cur, kill=kill, seq=seq)
 
 
 def expire_lease(d: str, i: int, timeout_s: float, kill: bool = True,
@@ -227,24 +183,7 @@ def expire_lease(d: str, i: int, timeout_s: float, kill: bool = True,
     Torn leases (no readable owner record) age by file mtime — a crash
     between acquire and owner-write must not pin the range forever.
     Returns the evicted owner record, or None when live/free."""
-    try:
-        st = os.stat(lease_path(d, i))
-    except OSError:
-        return None
-    cur = read_lease(d, i)
-    if cur is None:
-        return None
-    beat = None
-    if cur:
-        try:
-            beat = float(cur["renewed"])
-        except (KeyError, TypeError, ValueError):
-            beat = None
-    if beat is None:
-        beat = st.st_mtime
-    if time.time() - beat < timeout_s:
-        return None
-    return steal_lease(d, i, cur, kill=kill, seq=seq)
+    return leaselib.expire_lease(d, str(i), timeout_s, kill=kill, seq=seq)
 
 
 def reclaim_worker_leases(d: str, m: int, pid: int) -> List[int]:
@@ -253,13 +192,9 @@ def reclaim_worker_leases(d: str, m: int, pid: int) -> List[int]:
     timeout wait, no kill needed.  This is what keeps a mid-run
     SIGKILL's cost at ~one range of recompute instead of a full
     lease-timeout stall."""
-    freed = []
-    for i in range(m):
-        cur = read_lease(d, i)
-        if cur and cur.get("pid") == pid:
-            if steal_lease(d, i, cur, kill=False, seq=i) is not None:
-                freed.append(i)
-    return freed
+    freed = leaselib.reclaim_pid_leases(d, (str(i) for i in range(m)),
+                                        pid)
+    return [int(k) for k in freed]
 
 
 def queue_state(d: str, out_path: str, m: int) -> dict:
@@ -307,13 +242,19 @@ def _open_range_stream(in_path: str, cfg: CcsConfig, lo: int, hi: int,
 
 
 def run_range(d: str, state: dict, cfg: CcsConfig, i: int,
-              worker: str, inflight: Optional[int] = None) -> int:
+              worker: str, inflight: Optional[int] = None,
+              shared=None) -> int:
     """Stream range i through the batched driver into ``out.shard<i>``,
     exactly the per-rank flow of run_pipeline_sharded but with the
     range table as the sharding authority: M is the 'host count' the
     marker records, the idx header carries the table hash, and the
     per-range journal (fleet dir) pins range identity in its input_id
-    so a requeued range resumes its predecessor's durable cursor."""
+    so a requeued range resumes its predecessor's durable cursor.
+
+    ``shared`` is the resident server's warm runtime (pipeline/serve.py
+    ``_JobRuntime``): a serve replica running a fan-out range passes it
+    so the range reuses the replica's compiled executables and fair
+    admission window instead of cold-starting a tracer per range."""
     from ccsx_tpu.pipeline.batch import drive_batched, mesh_precheck
     from ccsx_tpu.utils.device import resolve_device
 
@@ -352,7 +293,8 @@ def run_range(d: str, state: dict, cfg: CcsConfig, i: int,
     except OSError:
         print("Cannot open file for write!", file=sys.stderr)
         return 1
-    rc = drive_batched(stream, writer, cfg, journal, metrics, inflight)
+    rc = drive_batched(stream, writer, cfg, journal, metrics, inflight,
+                       shared=shared)
     if rc == 0:
         committed = distributed._write_done_marker(
             out_path, i, m, journal.holes_done,

@@ -59,6 +59,25 @@ settle), persist the queue to <spool>/state.json, exit rc 75
 and completes them byte-identically.  benchmarks/serve_chaos.py is the
 seeded soak that proves the blast radius of each fault class stays in
 the faulted job.
+
+**Fleet mode (r16).**  ``serve --fleet <spool>`` makes this process
+one REPLICA of a fleet sharing <spool> as a job LEASE DOMAIN
+(pipeline/gateway.py is the spool protocol, utils/lease.py the
+machinery): a queued job is acquired with the kernel-arbitrated O_EXCL
+lease + heartbeat renewal, cross-replica cancel/deadline marks are
+observed at each renewal tick, and the terminal state commits through
+an EXCLUSIVE done marker — marker before lease release, so a zombie
+replica that survived expiry can never double-emit.  Replica death is
+requeue-by-construction: the lease expires, the job's journal survives
+in the spool, and the next scanning replica RESUMES it.  Jobs with at
+least --fanout-holes holes fan out across replicas through the PR 13
+range queue (helpers pull ranges into their warm runtime; a mid-fan-out
+kill costs about one range).  Each replica claims slot ``r<k>`` and
+serves on base_port + k, advertising the actual bound port in its slot
+heartbeat; `ccsx-tpu gateway` balances on the replicas' /readyz, and
+`shepherd --serve-replicas N` supervises the whole fleet.
+benchmarks/serve_fleet_chaos.py is the churn soak (SIGKILL mid-wave,
+mid-run join: zero lost, zero duplicated, byte-identical).
 """
 
 from __future__ import annotations
@@ -66,6 +85,8 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import shutil
+import socket
 import sys
 import threading
 import time
@@ -73,7 +94,9 @@ from typing import Dict, List, Optional
 
 from ccsx_tpu import exitcodes
 from ccsx_tpu.config import CcsConfig
+from ccsx_tpu.pipeline import gateway as spoolproto
 from ccsx_tpu.utils import faultinject
+from ccsx_tpu.utils import lease as leaselib
 from ccsx_tpu.utils.drain import FlagGuard
 from ccsx_tpu.utils.journal import write_json_atomic
 from ccsx_tpu.utils.metrics import Metrics
@@ -183,6 +206,14 @@ class FairWindow:
         with self._cv:
             self._cv.wait(timeout)
 
+    def pressure(self) -> float:
+        """Held fraction of the admission window [0, 1] — the per-
+        replica autoscale gauge the slot lease advertises (a fleet
+        whose replicas all sit near 1.0 wants more boxes)."""
+        with self._cv:
+            return round(sum(self._held.values())
+                         / float(self.capacity), 4)
+
 
 class JobAdmission:
     """One job's handle on the FairWindow — the duck-typed
@@ -250,6 +281,12 @@ class Job:
         self.guard: Optional[FlagGuard] = None
         self.thread: Optional[threading.Thread] = None
         self._stop_ev = threading.Event()
+        # fleet mode: the spool job lease this replica holds for the
+        # job (utils/lease.py record), lost-lease flag, and the hole
+        # count that triggered cross-replica fan-out (0 = solo)
+        self.lease: Optional[dict] = None
+        self.lease_lost = False
+        self.fanout_holes_n = 0
 
     def info(self) -> dict:
         snap = self.snap
@@ -291,7 +328,10 @@ class ServeCore:
     def __init__(self, cfg: CcsConfig, spool: str,
                  max_queue: int = 16, max_active: int = 2,
                  retries: int = 1, backoff_s: float = 0.5,
-                 job_deadline_s: float = 0.0):
+                 job_deadline_s: float = 0.0,
+                 fleet: bool = False, replica: Optional[str] = None,
+                 lease_timeout: float = 10.0, fanout_holes: int = 0,
+                 fanout_ranges: int = 0, poll_s: float = 0.25):
         from ccsx_tpu.utils import trace
 
         self.cfg = cfg
@@ -302,6 +342,22 @@ class ServeCore:
         self.retries = max(0, int(retries))
         self.backoff_s = max(0.0, float(backoff_s))
         self.job_deadline_s = max(0.0, float(job_deadline_s))
+        # fleet mode: the spool is a SHARED lease domain (pipeline/
+        # gateway.py spool protocol) — jobs are leased, not owned, and
+        # state.json is replaced by per-job records + markers
+        self.fleet = bool(fleet)
+        self.replica = replica or f"s{os.getpid()}"
+        self.lease_timeout = max(0.2, float(lease_timeout))
+        self.fanout_holes = max(0, int(fanout_holes))
+        self.fanout_ranges = max(0, int(fanout_ranges))
+        self.poll_s = max(0.05, float(poll_s))
+        self.hostname = socket.gethostname()
+        self.addr = os.environ.get("CCSX_ADVERTISE_HOST", "127.0.0.1")
+        self.advertised_port = 0
+        self._slot: Optional[int] = None
+        self._slot_rec: Optional[dict] = None
+        self._expiry_seq = 0
+        self._helpers: Dict[str, threading.Thread] = {}
         self.metrics = Metrics(verbose=0, stream=None)
         self._lock = threading.RLock()
         self._persist_lock = threading.Lock()
@@ -330,23 +386,56 @@ class ServeCore:
                                     stall_timeout=cfg.stall_timeout_s,
                                     metrics=self.metrics)
         trace.install(self._tracer)
-        self._restore_state()
+        if not self.fleet:
+            self._restore_state()
         self._mon_stop = threading.Event()
         self._mon = threading.Thread(target=self._monitor, daemon=True,
                                      name="ccsx-serve-monitor")
         self._mon.start()
+        self._scan_stop = threading.Event()
+        self._scan: Optional[threading.Thread] = None
+        if self.fleet:
+            self._scan = threading.Thread(target=self._spool_scan,
+                                          daemon=True,
+                                          name="ccsx-serve-spool")
+            self._scan.start()
         self._pump()
+
+    # ---- fleet plumbing ---------------------------------------------------
+
+    def register_replica(self) -> int:
+        """Claim a replica slot lease (``r<k>``) in the shared spool:
+        the deterministic port assignment (serve on base_port + k) and
+        the discovery record gateway/top scan.  The scan loop renews
+        it with readiness + load refreshed each heartbeat."""
+        slot, rec = spoolproto.acquire_replica_slot(
+            self.spool, self.replica,
+            extra={"addr": self.addr, "host": self.hostname,
+                   "port": self.advertised_port,
+                   "replica": self.replica},
+            lease_timeout=self.lease_timeout)
+        self._slot, self._slot_rec = slot, rec
+        return slot
+
+    def set_advertised(self, port: int,
+                       addr: Optional[str] = None) -> None:
+        self.advertised_port = int(port)
+        if addr:
+            self.addr = addr
 
     # ---- submission -------------------------------------------------------
 
     def submit(self, input_path: Optional[str] = None,
                body_stream=None, body_len: int = 0,
-               overrides: Optional[dict] = None) -> Job:
+               overrides: Optional[dict] = None):
         overrides = dict(overrides or {})
         unknown = [k for k in overrides
                    if k not in _CFG_OVERRIDES and k not in _JOB_OVERRIDES]
         if unknown:
             raise ValueError(f"unknown job option(s): {unknown}")
+        if self.fleet:
+            return self._submit_fleet(input_path, body_stream,
+                                      body_len, overrides)
         with self._lock:
             if not self.accepting:
                 raise Draining("server is draining")
@@ -382,6 +471,40 @@ class ServeCore:
         self._persist()
         self._pump()
         return job
+
+    def _submit_fleet(self, input_path, body_stream, body_len,
+                      overrides):
+        """Fleet-mode submit: write the job into the SHARED spool (the
+        spool is the queue — any replica, this one included, may lease
+        it) and return a lightweight queued handle.  Validation
+        matches solo submit; capacity is the fleet-wide spool depth,
+        not a local queue."""
+        fmt = str(overrides.get("format") or "").lower()
+        if fmt and fmt not in ("bam", "fastq", "fasta"):
+            raise ValueError(f"unknown input format {fmt!r}")
+        with self._lock:
+            if not self.accepting:
+                raise Draining("server is draining")
+        counts = spoolproto.spool_counts(self.spool)
+        depth = counts["queued"] + counts["cancelling"]
+        if depth >= self.max_queue:
+            raise QueueFull(
+                f"fleet spool full ({depth}/{self.max_queue})")
+        # overrides are validated here but coerced by whichever
+        # replica acquires the job (_build_job) — fail fast on the
+        # obviously bad ones so the submitter gets the 400, not a
+        # failed job
+        self._build_job("probe", input_path or "unspooled", overrides)
+        jid = spoolproto.submit_job(self.spool, input_path=input_path,
+                                    body_stream=body_stream,
+                                    body_len=body_len,
+                                    overrides=overrides)
+        class _Handle:
+            pass
+
+        h = _Handle()
+        h.id, h.state = jid, "queued"
+        return h
 
     def _build_job(self, jid: str, input_path: str,
                    overrides: dict) -> Job:
@@ -435,17 +558,389 @@ class ServeCore:
                 t.start()
 
     def _job_main(self, job: Job) -> None:
+        stop: Optional[threading.Event] = None
         try:
+            if self.fleet and job.lease is not None:
+                stop = threading.Event()
+                t = threading.Thread(target=self._job_renewer,
+                                     args=(job, stop), daemon=True,
+                                     name=f"ccsx-renew-{job.id}")
+                t.start()
             self._run_job(job)
         finally:
+            if stop is not None:
+                stop.set()
             with self._lock:
                 self._n_running -= 1
             self._persist()
             self._pump()
 
+    # ---- the fleet scan loop ----------------------------------------------
+
+    def _fleet_capacity(self) -> int:
+        with self._lock:
+            active = sum(1 for j in self._jobs.values()
+                         if j.state in ("queued", "running"))
+        active += sum(1 for t in self._helpers.values()
+                      if t.is_alive())
+        return self.max_active - active
+
+    def _spool_scan(self) -> None:
+        while not self._scan_stop.wait(self.poll_s):
+            try:
+                self._scan_once()
+            except Exception as e:  # the scan loop must survive churn
+                print(f"[ccsx-tpu] serve: spool scan error: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+
+    def _scan_once(self) -> None:
+        self._renew_slot()
+        jids = spoolproto.list_job_ids(self.spool)
+        for jid in jids:
+            if os.path.exists(
+                    spoolproto.done_marker_path(self.spool, jid)):
+                continue
+            rec = spoolproto.read_job_record(self.spool, jid) or {}
+            hold = leaselib.read_lease(self.spool, jid)
+            if hold is not None:
+                if (hold and hold.get("pid") == os.getpid()
+                        and hold.get("worker") == self.replica):
+                    continue  # ours: its renewer observes the record
+                # stale foreign holder: KILL-BEFORE-STEAL, but only
+                # when the lease names OUR host — a pid from another
+                # box must never be shot here
+                self._expiry_seq += 1
+                kill = (hold or {}).get("host") in (None, self.hostname)
+                evicted = leaselib.expire_lease(
+                    self.spool, jid, self.lease_timeout, kill=kill,
+                    seq=self._expiry_seq)
+                if evicted is not None:
+                    print(f"[ccsx-tpu] serve: {self.replica} requeued "
+                          f"job {jid} from "
+                          f"{evicted.get('worker') or 'unknown'} "
+                          "(lease expired)", file=sys.stderr)
+                continue
+            if rec.get("cancel"):
+                # cancelled while queued: any replica may retire it
+                # (the exclusive marker arbitrates racers)
+                if spoolproto.retire_job(self.spool, jid, "cancelled",
+                                         exitcodes.RC_INTERRUPTED,
+                                         self.replica):
+                    print(f"[ccsx-tpu] serve: {self.replica} retired "
+                          f"cancelled queued job {jid}",
+                          file=sys.stderr)
+                continue
+            with self._lock:
+                accepting = self.accepting and not self.draining
+            if not accepting or self._fleet_capacity() <= 0:
+                continue
+            lease_rec = leaselib.try_acquire(
+                self.spool, jid, self.replica,
+                extra={"replica": self.replica, "host": self.hostname,
+                       "addr": self.addr,
+                       "port": self.advertised_port})
+            if lease_rec is not None:
+                self._admit_fleet_job(jid, rec, lease_rec)
+        if self._fleet_capacity() > 0:
+            self._maybe_help_fanout(jids)
+
+    def _renew_slot(self) -> None:
+        if self._slot_rec is None:
+            return
+        ready, reason = self.readiness()
+        with self._lock:
+            held = sum(1 for j in self._jobs.values()
+                       if j.state in ("queued", "running"))
+        ok = leaselib.renew(
+            self.spool, f"{spoolproto.SLOT_PREFIX}{self._slot}",
+            self._slot_rec,
+            extra={"addr": self.addr, "host": self.hostname,
+                   "port": self.advertised_port,
+                   "replica": self.replica, "ready": ready,
+                   "reason": reason,
+                   "pressure": self.window.pressure(),
+                   "leases": held})
+        if not ok:
+            # evicted as presumed dead: re-register rather than serve
+            # undiscoverable (the bound port stays valid; the fresh
+            # slot record advertises it)
+            try:
+                self.register_replica()
+                self._renew_slot()
+            except RuntimeError as e:
+                print(f"[ccsx-tpu] serve: {self.replica} lost its "
+                      f"slot and could not re-register: {e}",
+                      file=sys.stderr)
+                self._slot_rec = None
+
+    def _admit_fleet_job(self, jid: str, rec: dict,
+                         lease_rec: dict) -> None:
+        try:
+            job = self._build_job(jid, rec.get("input") or "",
+                                  rec.get("overrides") or {})
+        except ValueError as e:
+            spoolproto.retire_job(self.spool, jid, "failed", 1,
+                                  self.replica, error=str(e))
+            leaselib.release(self.spool, jid, lease_rec)
+            return
+        job.lease = lease_rec
+        if self.fanout_holes > 0:
+            try:
+                from ccsx_tpu.pipeline.run import count_raw_holes
+
+                n = count_raw_holes(job.in_path, job.cfg)
+            except (OSError, RuntimeError, ValueError):
+                n = 0
+            if n >= self.fanout_holes:
+                job.fanout_holes_n = n
+        with self._lock:
+            self._jobs[jid] = job
+            self._queue.append(job)
+        self._pump()
+
+    def _job_renewer(self, job: Job, stop: threading.Event) -> None:
+        """Heartbeat-renew the job lease; every renewal also OBSERVES
+        the spool record — the cross-replica control channel: a cancel
+        (or tightened deadline) marked at the gateway lands here and
+        aborts through the job's own guard, the PR 15 blast-radius
+        path.  A failed renewal means we were expired as presumed
+        dead: stop emitting (the exclusive done marker stays the last
+        fence against a zombie double-commit)."""
+        interval = max(0.05, self.lease_timeout / 3.0)
+        while not stop.wait(interval):
+            rec = spoolproto.read_job_record(self.spool, job.id) or {}
+            if rec.get("cancel"):
+                with self._lock:
+                    if job.state == "running" and not job.stop_reason:
+                        self._signal_locked(job, "cancel")
+            dl = (rec.get("overrides") or {}).get("deadline_s")
+            if dl is not None:
+                try:
+                    job.deadline_s = float(dl)
+                except (TypeError, ValueError):
+                    pass
+            if not leaselib.renew(self.spool, job.id, job.lease):
+                job.lease_lost = True
+                with self._lock:
+                    if not job.stop_reason:
+                        self._signal_locked(job, "drain")
+                return
+
+    # ---- cross-replica fan-out --------------------------------------------
+
+    def _fanout_dir(self, jid: str) -> str:
+        return os.path.join(self.spool, f"fanout.{jid}")
+
+    def _run_fanout(self, job: Job) -> None:
+        """Run one big job through the PR 13 range queue INSIDE the
+        spool: the holder splits the input into M leased ranges
+        (fleet.init_fleet — re-opening after a holder death RESUMES
+        the same table, so a mid-fan-out kill costs ~one range), pulls
+        ranges alongside any helping sibling replicas, and merges
+        under the range-table fence.  Ranges run with the replica's
+        warm runtime (shared=) so fan-out costs no recompiles."""
+        from ccsx_tpu.parallel import distributed
+        from ccsx_tpu.pipeline import fleet
+
+        guard = FlagGuard()
+        with self._lock:
+            job.attempts += 1
+            job.guard = guard
+            if job.stop_reason:
+                guard.request(job.stop_reason)
+        n = job.fanout_holes_n
+        m = self.fanout_ranges or min(n, max(2, 2 * self.max_active))
+        d = self._fanout_dir(job.id)
+        metrics = Metrics(verbose=0, stream=None)
+        metrics.job = job.id
+        job.metrics = metrics
+        try:
+            state = fleet.init_fleet(d, job.in_path, job.out_path, n,
+                                     m, self.lease_timeout)
+        except (OSError, ValueError) as e:
+            job.error = f"fan-out init failed: {e}"
+            self._finish(job, "failed", 1)
+            return
+        rec = spoolproto.read_job_record(self.spool, job.id) or {}
+        if rec.get("fanout") != m:
+            # advertise the fan-out so sibling replicas pull ranges
+            rec["fanout"] = m
+            write_json_atomic(
+                spoolproto.job_record_path(self.spool, job.id), rec)
+        adm = JobAdmission(self.window, job.id)
+        rt = _JobRuntime(self.warm, self.warm_cache, guard, adm)
+        renew_s = max(0.05, self.lease_timeout / 3.0)
+        rc = 0
+        try:
+            while True:
+                if guard.requested:
+                    rc = exitcodes.RC_INTERRUPTED
+                    break
+                progressed = pending = False
+                for i in range(m):
+                    if guard.requested:
+                        break
+                    if os.path.exists(
+                            distributed.done_path(job.out_path, i)):
+                        continue
+                    pending = True
+                    lr = fleet.try_acquire(d, i, self.replica)
+                    if lr is None:
+                        # a helper (or a dead helper) holds it: expiry
+                        # keeps a killed sibling from pinning a range
+                        self._expiry_seq += 1
+                        fleet.expire_lease(d, i, self.lease_timeout,
+                                           seq=self._expiry_seq)
+                        continue
+                    stop = threading.Event()
+                    t = threading.Thread(
+                        target=fleet._renewer,
+                        args=(d, i, lr, renew_s, stop), daemon=True)
+                    t.start()
+                    try:
+                        rrc = fleet.run_range(d, state, job.cfg, i,
+                                              self.replica,
+                                              inflight=job.inflight,
+                                              shared=rt)
+                    finally:
+                        stop.set()
+                        t.join(timeout=1.0)
+                    fleet.release(d, i, lr)
+                    if rrc != 0:
+                        rc = rrc
+                        break
+                    progressed = True
+                if rc:
+                    break
+                if not pending:
+                    break
+                if not progressed:
+                    time.sleep(0.2)  # helpers hold the remaining ranges
+            if rc == 0:
+                try:
+                    distributed.merge_shards(
+                        job.out_path, m, expect_table=state["table"])
+                except (OSError, ValueError) as e:
+                    job.error = f"fan-out merge failed: {e}"
+                    rc = 1
+        finally:
+            adm.close()
+            job.snap = metrics.snapshot()
+        if rc == 0:
+            self._finish(job, "done", exitcodes.RC_OK)
+            shutil.rmtree(d, ignore_errors=True)
+        elif rc == exitcodes.RC_INTERRUPTED:
+            reason = job.stop_reason or guard.reason or "drain"
+            if reason == "cancel":
+                self._finish(job, "cancelled", rc)
+            elif reason == "deadline":
+                job.error = (f"job deadline "
+                             f"({job.deadline_s:g}s) exceeded")
+                self._finish(job, "failed", rc)
+            else:
+                self._finish(job, "interrupted", rc)
+        elif rc == exitcodes.RC_FAILED_HOLES:
+            job.error = job.error or "failure budget exceeded"
+            self._finish(job, "failed", rc)
+        else:
+            job.error = job.error or f"rc {rc}"
+            self._finish(job, "failed", rc)
+
+    def _maybe_help_fanout(self, jids: List[str]) -> None:
+        """Idle capacity pulls ranges of ANOTHER replica's fan-out job
+        — the cross-replica half of the fan-out story.  At most one
+        new helper per scan tick keeps admission fair."""
+        for jid in jids:
+            if os.path.exists(
+                    spoolproto.done_marker_path(self.spool, jid)):
+                continue
+            rec = spoolproto.read_job_record(self.spool, jid) or {}
+            if not rec.get("fanout") or rec.get("cancel"):
+                continue
+            hold = leaselib.read_lease(self.spool, jid)
+            if not hold or hold.get("pid") == os.getpid():
+                continue
+            t = self._helpers.get(jid)
+            if t is not None and t.is_alive():
+                continue
+            t = threading.Thread(target=self._help_fanout, args=(jid,),
+                                 daemon=True, name=f"ccsx-help-{jid}")
+            self._helpers[jid] = t
+            t.start()
+            return
+
+    def _help_fanout(self, jid: str) -> None:
+        from ccsx_tpu.parallel import distributed
+        from ccsx_tpu.pipeline import fleet
+
+        d = self._fanout_dir(jid)
+        state = fleet.load_fleet(d)
+        if state is None:
+            return
+        m = len(state["ranges"])
+        rec = spoolproto.read_job_record(self.spool, jid) or {}
+        try:
+            # the record's overrides rebuild the HOLDER's exact cfg —
+            # identical fingerprint, so helper shards interleave with
+            # holder shards under one table
+            job = self._build_job(jid, rec.get("input")
+                                  or state["input"],
+                                  rec.get("overrides") or {})
+        except ValueError:
+            return
+        guard = FlagGuard()
+        adm = JobAdmission(self.window, f"{jid}/help")
+        rt = _JobRuntime(self.warm, self.warm_cache, guard, adm)
+        renew_s = max(0.05, self.lease_timeout / 3.0)
+        try:
+            while True:
+                with self._lock:
+                    if self.draining:
+                        return
+                cur = spoolproto.read_job_record(self.spool, jid) or {}
+                if (cur.get("cancel") or os.path.exists(
+                        spoolproto.done_marker_path(self.spool, jid))):
+                    return
+                got = False
+                for i in range(m):
+                    if os.path.exists(
+                            distributed.done_path(state["output"], i)):
+                        continue
+                    try:
+                        lr = fleet.try_acquire(d, i, self.replica)
+                    except FileNotFoundError:
+                        return  # holder merged and cleaned up: done
+                    if lr is None:
+                        continue
+                    stop = threading.Event()
+                    t = threading.Thread(
+                        target=fleet._renewer,
+                        args=(d, i, lr, renew_s, stop), daemon=True)
+                    t.start()
+                    try:
+                        rrc = fleet.run_range(d, state, job.cfg, i,
+                                              self.replica,
+                                              inflight=job.inflight,
+                                              shared=rt)
+                    finally:
+                        stop.set()
+                        t.join(timeout=1.0)
+                    fleet.release(d, i, lr)
+                    if rrc != 0:
+                        return  # recovery belongs to the holder
+                    got = True
+                    break  # recheck cancel/drain between ranges
+                if not got:
+                    return  # nothing free: the holder is finishing
+        finally:
+            adm.close()
+
     def _run_job(self, job: Job) -> None:
         from ccsx_tpu.pipeline.batch import run_pipeline_batched
 
+        if self.fleet and job.fanout_holes_n:
+            self._run_fanout(job)
+            return
         while True:
             guard = FlagGuard()
             with self._lock:
@@ -534,6 +1029,31 @@ class ServeCore:
             job.finished_at = time.time()
             if state == "done":
                 self._completed_any = True
+        if self.fleet and job.lease is not None:
+            self._retire_fleet_job(job, state, rc)
+
+    def _retire_fleet_job(self, job: Job, state: str,
+                          rc: Optional[int]) -> None:
+        """Commit the terminal state to the spool (marker BEFORE lease
+        release — the same crash-window ordering as range retirement:
+        a kill between the two leaves a done job with a releasable
+        lease, never a lost one).  'interrupted' writes NO marker: the
+        journal is durable and a survivor resumes the job."""
+        if state in spoolproto.MARKER_STATES:
+            committed = spoolproto.retire_job(
+                self.spool, job.id, state, rc, self.replica,
+                error=job.error, output=job.out_path,
+                attempts=job.attempts)
+            if not committed:
+                # the exclusive fence lost: a survivor already retired
+                # this job while we were presumed dead — its marker
+                # vouches, ours must not
+                print(f"[ccsx-tpu] serve: job {job.id} was already "
+                      "retired by another replica; yielding to its "
+                      "marker", file=sys.stderr)
+                with self._lock:
+                    job.state = "interrupted"
+        leaselib.release(self.spool, job.id, job.lease)
 
     # ---- control plane ----------------------------------------------------
 
@@ -545,12 +1065,18 @@ class ServeCore:
             job.guard.request(reason)
 
     def cancel(self, jid: str):
-        """-> (state, changed).  KeyError for an unknown id."""
+        """-> (state, changed).  KeyError for an unknown id.  In fleet
+        mode a job this replica does NOT hold is cancelled by marking
+        the shared spool record — the holder's next heartbeat renewal
+        observes the mark and aborts (the cross-replica cancel path
+        the gateway uses too)."""
         with self._lock:
-            job = self._jobs[jid]
-            if job.state in TERMINAL:
+            job = self._jobs.get(jid)
+            if job is None:
+                pass  # fall through to the spool mark below
+            elif job.state in TERMINAL:
                 return job.state, False
-            if job.state == "queued":
+            elif job.state == "queued":
                 if job in self._queue:
                     self._queue.remove(job)
                 job.state = "cancelled"
@@ -558,6 +1084,14 @@ class ServeCore:
                 job.finished_at = time.time()
             else:
                 self._signal_locked(job, "cancel")
+        if job is None:
+            if self.fleet:
+                return spoolproto.mark_cancel(self.spool, jid)
+            raise KeyError(jid)
+        if job.state == "cancelled" and self.fleet and job.lease:
+            # cancelled before its thread started: retire + release
+            # here — no _run_job will do it for us
+            self._retire_fleet_job(job, "cancelled", job.rc)
         self._persist()
         return job.state, True
 
@@ -585,13 +1119,34 @@ class ServeCore:
             self.draining = True
             running = [j for j in self._jobs.values()
                        if j.state == "running"]
+            queued_leased = [j for j in self._jobs.values()
+                             if j.state == "queued"
+                             and j.lease is not None]
             for job in running:
                 self._signal_locked(job, "drain")
+            for job in queued_leased:
+                # acquired but never started: hand the lease straight
+                # back so a survivor picks the job up NOW, not after a
+                # timeout
+                job.state = "interrupted"
+                job.rc = exitcodes.RC_INTERRUPTED
+                job.finished_at = time.time()
+        if self.fleet:
+            self._scan_stop.set()
+        for job in queued_leased:
+            leaselib.release(self.spool, job.id, job.lease)
         deadline = time.monotonic() + max(0.0, timeout)
         for job in running:
             t = job.thread
             if t is not None:
                 t.join(max(0.1, deadline - time.monotonic()))
+        for t in list(self._helpers.values()):
+            t.join(max(0.1, deadline - time.monotonic()))
+        if self._slot_rec is not None:
+            leaselib.release(self.spool,
+                             f"{spoolproto.SLOT_PREFIX}{self._slot}",
+                             self._slot_rec)
+            self._slot_rec = None
         self._persist()
         with self._lock:
             resumable = any(j.state in ("queued", "running",
@@ -607,7 +1162,10 @@ class ServeCore:
                 return
             self._closed = True
         self._mon_stop.set()
+        self._scan_stop.set()
         self._mon.join(timeout=5.0)
+        if self._scan is not None:
+            self._scan.join(timeout=5.0)
         if self.warm is not None:
             self.warm.close()
         trace.uninstall()
@@ -645,16 +1203,28 @@ class ServeCore:
         return c
 
     def wait(self, jid: str, timeout: float = 120.0) -> str:
-        """Block until the job reaches a terminal state (tests)."""
+        """Block until the job reaches a terminal state (tests).  In
+        fleet mode a job not held locally is waited on through the
+        spool view — it may be running on ANY replica."""
         deadline = time.monotonic() + timeout
+        state = None
         while time.monotonic() < deadline:
             job = self.job(jid)
-            if job is None:
+            if job is not None:
+                state = job.state
+                if state in TERMINAL:
+                    return state
+            elif self.fleet:
+                view = spoolproto.job_view(self.spool, jid)
+                if view is None:
+                    raise KeyError(jid)
+                state = view["state"]
+                if state in spoolproto.MARKER_STATES:
+                    return state
+            else:
                 raise KeyError(jid)
-            if job.state in TERMINAL:
-                return job.state
             time.sleep(0.02)
-        return self.job(jid).state
+        return state
 
     def readiness(self):
         """The /readyz hook: (ready, reason).  NOT tied to degraded —
@@ -675,6 +1245,10 @@ class ServeCore:
     # ---- restart persistence ----------------------------------------------
 
     def _persist(self) -> None:
+        if self.fleet:
+            # fleet mode has no state.json: the spool records, leases
+            # and markers ARE the durable state, shared by all replicas
+            return
         with self._lock:
             recs = []
             for j in self._jobs.values():
@@ -797,21 +1371,39 @@ def _serve_handler():
                                "text/plain; version=0.0.4; "
                                "charset=utf-8")
                 elif path == "/jobs":
-                    self._send_json(200, {"jobs": core.jobs()})
+                    if core.fleet:
+                        from ccsx_tpu.pipeline import gateway as gw
+
+                        jobs = [gw.job_view(core.spool, jid)
+                                for jid in gw.list_job_ids(core.spool)]
+                        self._send_json(200, {"jobs": jobs})
+                    else:
+                        self._send_json(200, {"jobs": core.jobs()})
                 elif path.startswith("/jobs/"):
                     parts = path.split("/")
                     job = core.job(parts[2])
-                    if job is None:
+                    view = None
+                    if job is None and core.fleet:
+                        # a fleet job another replica holds (or no one
+                        # does yet): answer from the shared spool
+                        from ccsx_tpu.pipeline import gateway as gw
+
+                        view = gw.job_view(core.spool, parts[2])
+                    if job is None and view is None:
                         self._send_json(404, {"error": "unknown job"})
                     elif len(parts) == 3:
-                        self._send_json(200, job.info())
+                        self._send_json(200, job.info() if job
+                                        else view)
                     elif len(parts) == 4 and parts[3] == "output":
-                        if job.state != "done":
+                        state = job.state if job else view["state"]
+                        if state != "done":
                             self._send_json(
                                 409, {"error": "job not done",
-                                      "state": job.state})
+                                      "state": state})
                         else:
-                            self._send_file(job.out_path)
+                            self._send_file(job.out_path if job
+                                            else view.get("output")
+                                            or "")
                     else:
                         self._send_json(404, {"error": "unknown path"})
                 else:
@@ -933,6 +1525,26 @@ def serve_main(argv) -> int:
                     help="default per-job wall-clock deadline in "
                          "seconds, across retries (0 = none; jobs can "
                          "set their own deadline_s) [0]")
+    ap.add_argument("--fleet", default=None, metavar="SPOOL",
+                    help="run as one replica of a serve FLEET sharing "
+                         "SPOOL as a job lease domain (replaces "
+                         "--spool; jobs are leased, replica death "
+                         "requeues them, `ccsx-tpu gateway` balances)")
+    ap.add_argument("--replica-name", default=None,
+                    help="replica identity in leases/markers "
+                         "[s<pid>]")
+    ap.add_argument("--lease-timeout", type=float, default=10.0,
+                    help="job-lease heartbeat timeout seconds (fleet "
+                         "mode) [10]")
+    ap.add_argument("--fanout-holes", type=int, default=0,
+                    help="fan a job out across replicas through the "
+                         "range queue when it has at least this many "
+                         "holes (0 = never) [0]")
+    ap.add_argument("--fanout-ranges", type=int, default=0,
+                    help="range count M for fan-out jobs (0 = auto; "
+                         "must match across replicas) [0]")
+    ap.add_argument("--poll", type=float, default=0.25,
+                    help="fleet spool scan interval seconds [0.25]")
     a, rest = ap.parse_known_args(argv)
     cli_args = cli.build_parser().parse_args(rest)
     if cli_args.help:
@@ -963,13 +1575,26 @@ def serve_main(argv) -> int:
             return 1
 
     guard = DrainGuard.install()
-    core = ServeCore(cfg, spool=a.spool, max_queue=a.max_queue,
+    spool = a.fleet or a.spool
+    core = ServeCore(cfg, spool=spool, max_queue=a.max_queue,
                      max_active=a.max_active, retries=a.job_retries,
                      backoff_s=a.retry_backoff,
-                     job_deadline_s=a.job_deadline)
+                     job_deadline_s=a.job_deadline,
+                     fleet=bool(a.fleet), replica=a.replica_name,
+                     lease_timeout=a.lease_timeout,
+                     fanout_holes=a.fanout_holes,
+                     fanout_ranges=a.fanout_ranges, poll_s=a.poll)
+    port = a.port
+    if a.fleet:
+        # deterministic co-hosted ports: replica in slot k serves on
+        # base_port + k, and the slot lease advertises the ACTUAL
+        # bound port — gateway/top discover it, never guess it
+        slot = core.register_replica()
+        if port:
+            port = port + slot
     try:
         srv = telemetry.TelemetryServer(
-            core.metrics, a.port, host=a.serve_host,
+            core.metrics, port, host=a.serve_host,
             handler=_serve_handler(),
             attrs={"ccsx_core": core, "ccsx_ready": core.readiness})
     except OSError as e:
@@ -977,9 +1602,12 @@ def serve_main(argv) -> int:
         core.close()
         guard.restore()
         return 1
+    core.set_advertised(srv.port)
+    mode = (f"fleet replica {core.replica} slot {core._slot}"
+            if a.fleet else "solo")
     print(f"[ccsx-tpu] serve: http://{srv.host}:{srv.port} "
           "(POST /jobs, GET /jobs/<id>, /readyz, /metrics; "
-          f"spool {a.spool})", file=sys.stderr)
+          f"spool {spool}; {mode})", file=sys.stderr)
     try:
         while not guard.requested:
             time.sleep(0.2)

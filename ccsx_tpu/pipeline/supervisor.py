@@ -734,11 +734,215 @@ def fleet_join(d: str, hosts: int,
     return rc
 
 
+def serve_fleet_run(spool: str, n: int, serve_args: List[str],
+                    max_restarts: int = 2,
+                    backoff_s: float = 1.0,
+                    gateway_port: int = 0,
+                    env: Optional[dict] = None,
+                    poll_s: float = 0.25,
+                    drain_grace_s: float = 30.0,
+                    runner_prelude: Optional[str] = None) -> int:
+    """`ccsx-tpu shepherd --serve-replicas N ...serve flags...`: run N
+    warm serve replicas over ONE job spool (the lease domain,
+    pipeline/gateway.py), optionally fronted by the thin gateway.
+
+    The spool itself is what makes this supervision loop simple: a
+    replica death loses no jobs — its leases age out and the survivors
+    re-acquire them — so the shepherd's only duties are capacity
+    (relaunch dead replicas, with backoff, while the budget lasts) and
+    lifecycle (SIGTERM here fans out as SIGTERM to every child, each
+    drains rc 75 releasing its leases, queued jobs stay in the spool
+    for the next start).
+
+    * rc 0 / rc 75 from a replica is a clean exit / voluntary leave —
+      not restarted (the operator or its own drain asked for it);
+    * rc 2 (deterministic budget abort) is not restartable;
+    * any other exit restarts with exponential backoff up to
+      ``max_restarts``; an exhausted replica fails the run's rc (1)
+      but the SURVIVORS keep serving until drained.
+    * the gateway child (``gateway_port`` > 0) is stateless and gets
+      the same restart budget; losing it degrades ingress only — the
+      replicas keep draining the spool.
+    """
+    from ccsx_tpu.utils.drain import DrainGuard
+
+    if n < 1:
+        print("Error: --serve-replicas needs N >= 1", file=sys.stderr)
+        return exitcodes.RC_FATAL
+    base_env = dict(os.environ if env is None else env)
+    prelude = (default_prelude() if runner_prelude is None
+               else runner_prelude)
+    try:
+        os.makedirs(spool, exist_ok=True)
+    except OSError as e:
+        print(f"Error: cannot create spool {spool}: {e}",
+              file=sys.stderr)
+        return exitcodes.RC_FATAL
+
+    def launch(w: _Rank) -> None:
+        if w.rank < 0:    # the gateway child
+            name = "gateway"
+            cmd = [sys.executable, "-c", prelude + _RUNNER, "gateway",
+                   "--spool", spool, "--port", str(gateway_port)]
+        else:
+            name = f"s{w.rank}"
+            cmd = [sys.executable, "-c", prelude + _RUNNER, "serve",
+                   *serve_args, "--replica-name", name]
+        log_path = os.path.join(spool, f"{name}.log")
+        banner = (f"\n=== serve-fleet launch {name} attempt "
+                  f"{w.attempts} @ {time.strftime('%H:%M:%S')} ===\n")
+        w.proc, w.log = _spawn_worker(cmd, dict(base_env), log_path,
+                                      banner)
+        w.relaunch_at = None
+        print(f"[ccsx-tpu] serve-fleet: {name} up (pid {w.proc.pid}, "
+              f"attempt {w.attempts}, log {log_path})", file=sys.stderr)
+
+    def close_log(w: _Rank) -> None:
+        if w.log is not None:
+            try:
+                w.log.close()
+            except OSError:
+                pass
+            w.log = None
+
+    replicas = [_Rank(rank=k) for k in range(n)]
+    children = list(replicas)
+    if gateway_port:
+        children.append(_Rank(rank=-1))
+    guard = DrainGuard.install()
+    try:
+        for w in children:
+            launch(w)
+        while not guard.requested:
+            now = time.monotonic()
+            if all(w.done for w in replicas):
+                break
+            for w in children:
+                if w.done:
+                    continue
+                if w.proc is None:
+                    if w.relaunch_at is not None and now >= w.relaunch_at:
+                        launch(w)
+                    continue
+                rc = w.proc.poll()
+                if rc is None:
+                    continue
+                name = "gateway" if w.rank < 0 else f"s{w.rank}"
+                close_log(w)
+                w.proc = None
+                if rc in (0, exitcodes.RC_INTERRUPTED):
+                    # clean exit or voluntary drain: the replica's
+                    # leases are released, its queued work stays in
+                    # the spool — the survivors absorb it
+                    w.done = True
+                    w.drained = rc == exitcodes.RC_INTERRUPTED
+                    print(f"[ccsx-tpu] serve-fleet: {name} left "
+                          f"(rc {rc}); spool jobs stay with the "
+                          "survivors", file=sys.stderr)
+                elif rc == exitcodes.RC_FAILED_HOLES:
+                    w.done = True
+                    w.failed = (f"{name} aborted on a deterministic "
+                                f"budget (rc {rc}); not restartable")
+                    w.failed_rc = rc
+                    print(f"[ccsx-tpu] serve-fleet: {w.failed}",
+                          file=sys.stderr)
+                elif w.attempts >= max_restarts:
+                    w.done = True
+                    w.failed = (f"{name} died (rc {rc}) and exhausted "
+                                f"its {max_restarts} restart(s)")
+                    w.failed_rc = rc
+                    print(f"[ccsx-tpu] serve-fleet: {w.failed}; "
+                          "its leased jobs requeue by lease timeout",
+                          file=sys.stderr)
+                else:
+                    w.attempts += 1
+                    delay = backoff_s * (2 ** (w.attempts - 1))
+                    w.relaunch_at = now + delay
+                    print(f"[ccsx-tpu] serve-fleet: {name} died "
+                          f"(rc {rc}); relaunching in {delay:g}s "
+                          f"(attempt {w.attempts}/{max_restarts}; its "
+                          "leased jobs requeue by lease timeout)",
+                          file=sys.stderr)
+            time.sleep(poll_s)
+    finally:
+        guard.restore()
+        # fan the stop out as SIGTERM — every replica drains (finishes
+        # in-flight holes, releases its leases, rc 75) before we give
+        # up and SIGKILL stragglers
+        live = [w for w in children
+                if w.proc is not None and w.proc.poll() is None]
+        for w in live:
+            try:
+                w.proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        deadline = time.monotonic() + drain_grace_s
+        for w in live:
+            try:
+                w.proc.wait(timeout=max(0.1, deadline
+                                        - time.monotonic()))
+            except (OSError, subprocess.TimeoutExpired):
+                try:
+                    w.proc.kill()
+                    w.proc.wait(timeout=10.0)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+        for w in children:
+            close_log(w)
+    failed = [w for w in children if w.failed]
+    if failed:
+        print("Error: serve-fleet run failed: "
+              + "; ".join(w.failed for w in failed)
+              + " — the spool keeps every queued/leased job; restart "
+              "the fleet to resume", file=sys.stderr)
+        rcs = {w.failed_rc for w in failed}
+        if rcs == {exitcodes.RC_FAILED_HOLES}:
+            return exitcodes.RC_FAILED_HOLES
+        return exitcodes.RC_FATAL
+    return exitcodes.RC_OK
+
+
+def _serve_fleet_main(argv) -> int:
+    """The --serve-replicas spelling of the shepherd: everything that
+    is not a supervisor knob forwards verbatim to each `serve` child
+    (which is why this branches BEFORE the ordinary CLI parser — serve
+    flags like --fleet/--port are not in its grammar)."""
+    p = argparse.ArgumentParser(
+        prog="ccsx-tpu shepherd --serve-replicas", add_help=False)
+    p.add_argument("--serve-replicas", type=int, dest="n")
+    p.add_argument("--gateway-port", type=int, default=0,
+                   dest="gateway_port")
+    p.add_argument("--max-replica-restarts", type=int, default=2,
+                   dest="max_replica_restarts")
+    p.add_argument("--replica-backoff", type=float, default=1.0,
+                   dest="replica_backoff")
+    args, serve_args = p.parse_known_args(argv)
+    spool = None
+    for i, a in enumerate(serve_args):
+        if a == "--fleet" and i + 1 < len(serve_args):
+            spool = serve_args[i + 1]
+        elif a.startswith("--fleet="):
+            spool = a.split("=", 1)[1]
+    if not spool:
+        print("Error: --serve-replicas requires --fleet SPOOL (the "
+              "shared job spool every replica serves)", file=sys.stderr)
+        return exitcodes.RC_FATAL
+    return serve_fleet_run(
+        spool, args.n, serve_args,
+        max_restarts=args.max_replica_restarts,
+        backoff_s=args.replica_backoff,
+        gateway_port=args.gateway_port)
+
+
 def shepherd_main(argv) -> int:
     """The `ccsx-tpu shepherd` subcommand (dispatched from cli.main):
     the ordinary CLI grammar plus the supervisor knobs; everything
     except the shepherd-only flags forwards verbatim to the ranks."""
     from ccsx_tpu import cli as cli_mod
+
+    if any(a == "--serve-replicas" or a.startswith("--serve-replicas=")
+           for a in argv):
+        return _serve_fleet_main(argv)
 
     p = cli_mod.build_parser()
     p.prog = "ccsx-tpu shepherd"

@@ -133,6 +133,16 @@ JOB_PROM_COUNTERS = (
     "host_fallbacks",
 )
 JOB_PROM_GAUGES = ("zmws_per_sec", "elapsed_s")
+# serve-fleet autoscale gauges the gateway exports (ccsx_fleet_*):
+# fleet-wide scalars from the job spool + replica slot leases
+# (pipeline/gateway.py fleet_summary), and per-replica labeled gauges
+# ({replica="..."}).  Schema-guarded like the tuples above
+# (tests/test_serve_fleet.py cross-checks the renderer both ways).
+FLEET_SERVE_GAUGES = (
+    "fleet_spool_depth", "fleet_jobs_leased", "fleet_jobs_retired",
+    "fleet_replicas", "fleet_replicas_ready",
+)
+FLEET_REPLICA_GAUGES = ("fleet_window_pressure", "fleet_leases_held")
 
 
 # ---- Prometheus text rendering --------------------------------------------
@@ -248,6 +258,33 @@ def render_job_series(jobs: dict) -> str:
             sample(key, (snap or {}).get(key), "gauge", labels)
         if (snap or {}).get("degraded"):
             sample("degraded", 1, "gauge", labels)
+    return ("\n".join(lines) + "\n") if lines else ""
+
+
+def render_fleet_series(summary: dict) -> str:
+    """The serve-fleet autoscale gauges (``summary`` is pipeline/
+    gateway.fleet_summary's output): fleet-wide scalars from
+    FLEET_SERVE_GAUGES, then the per-replica FLEET_REPLICA_GAUGES
+    labeled ``{replica="..."}`` — the signals an autoscaler sizes the
+    replica count by."""
+    lines: List[str] = []
+    for key in FLEET_SERVE_GAUGES:
+        v = _num(summary.get(key))
+        if v is None:
+            continue
+        lines.append(f"# TYPE ccsx_{key} gauge")
+        lines.append(f"ccsx_{key} {v}")
+    typed: set = set()
+    for name, per in sorted((summary.get("replicas") or {}).items()):
+        labels = f'{{replica="{_prom_escape(name)}"}}'
+        for key in FLEET_REPLICA_GAUGES:
+            v = _num((per or {}).get(key))
+            if v is None:
+                continue
+            if key not in typed:
+                typed.add(key)
+                lines.append(f"# TYPE ccsx_{key} gauge")
+            lines.append(f"ccsx_{key}{labels} {v}")
     return ("\n".join(lines) + "\n") if lines else ""
 
 
@@ -417,6 +454,26 @@ def tail_metrics_jsonl(path: str, max_bytes: int = 262144):
         if isinstance(rec, dict) and "event" in rec:
             return rec
     return None
+
+
+def expand_sources(sources: List[str]) -> List[str]:
+    """A DIRECTORY source is a serve-fleet spool: expand it to the
+    replica endpoints advertised in its slot leases (pipeline/
+    gateway.replica_endpoints), re-discovered on every refresh so a
+    replica join/death shows up within one frame.  A spool with no
+    live replicas contributes a sentinel source that renders
+    unreachable — an empty fleet must look DOWN, not like an empty
+    argument list."""
+    out: List[str] = []
+    for src in sources:
+        if os.path.isdir(src):
+            from ccsx_tpu.pipeline.gateway import replica_endpoints
+
+            eps = replica_endpoints(src)
+            out.extend(eps if eps else [os.path.join(src, "<no-replicas>")])
+        else:
+            out.append(src)
+    return out
 
 
 def read_source(src: str, timeout: float = 2.0) -> dict:
@@ -593,8 +650,10 @@ def top_main(argv) -> int:
                     "files; multi-rank sources aggregate (counters "
                     "sum, min progress, any-degraded).")
     ap.add_argument("sources", nargs="+",
-                    help="telemetry endpoints (host:port or http URLs) "
-                         "and/or --metrics JSONL paths, any mix")
+                    help="telemetry endpoints (host:port or http URLs), "
+                         "--metrics JSONL paths, and/or serve-fleet "
+                         "spool DIRECTORIES (expanded to the replica "
+                         "endpoints in their slot leases), any mix")
     ap.add_argument("--interval", type=float, default=2.0,
                     help="refresh seconds [2.0]")
     ap.add_argument("--once", action="store_true",
@@ -607,7 +666,7 @@ def top_main(argv) -> int:
     try:
         while True:
             sources = [read_source(s, timeout=a.timeout)
-                       for s in a.sources]
+                       for s in expand_sources(a.sources)]
             agg = aggregate(sources)
             frame = render_top(sources, agg, color=color)
             if a.once:

@@ -112,6 +112,8 @@ def test_leading_batch_dims():
         np.asarray(r.score).ravel(), np.asarray(flat[0].score))
 
 
+@pytest.mark.slow  # ~7s: with_stats-knob A/B; test_bit_exact_random_batch
+# keeps the kernel's bit-exactness tier-1 (r16 budget audit)
 def test_with_stats_false_same_moves_and_score():
     """The slim kernel (with_stats=False — the consensus-round config,
     star._aligner) must emit bit-identical moves/offs/score; mat/aln are
@@ -142,6 +144,8 @@ def test_with_stats_false_same_moves_and_score():
             m3[i, :ql], m2[i, :ql], err_msg=f"moves mismatch, problem {i}")
 
 
+@pytest.mark.slow  # ~12s: gblock-knob A/B; test_rotband_slim_and_gblock
+# keeps gblock coverage tier-1 (r16 budget audit)
 def test_gblock_override_bit_exact():
     """A non-default problem block (gblock=16, the A/B sweep knob) must
     not change any output."""

@@ -56,6 +56,8 @@ def test_chaos_fast_slice(tmp_path):
     # the tier-1 slice's wall for no new coverage (r11 duration audit)
 
 
+@pytest.mark.slow  # ~9s: serve's device-hang degradation pin and the
+# seeded chaos fast slice stay tier-1 (r16 budget audit)
 def test_chaos_hang_trial_directly(tmp_path):
     """The permanent-hang trial in isolation (the seeded menu draw
     above may or may not include it): device_hang under a dispatch

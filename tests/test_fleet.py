@@ -26,7 +26,19 @@ from ccsx_tpu.io import bamindex
 from ccsx_tpu.parallel import distributed
 from ccsx_tpu.pipeline import fleet, supervisor
 from ccsx_tpu.utils import synth
-from ccsx_tpu.utils.journal import write_json_atomic, write_json_exclusive
+from ccsx_tpu.utils.journal import write_json_atomic
+
+import test_lease  # the shared lease crash-consistency scenario bodies
+
+# fleet.py's integer-range lease API, adapted to the shared checkers:
+# r16 extracted the state machine into utils/lease.py, and running the
+# SAME scenario bodies through both key domains is the
+# behavior-preservation proof for that refactor.
+FLEET_OPS = test_lease.LeaseOps(
+    path=fleet.lease_path, read=fleet.read_lease,
+    acquire=fleet.try_acquire, renew=fleet.renew,
+    expire=fleet.expire_lease, release=fleet.release,
+    graveyard=fleet.GRAVEYARD)
 
 
 # ---------- range split + table identity ----------
@@ -70,85 +82,31 @@ def test_init_fleet_refuses_foreign_table(tmp_path):
 # ---------- lease crash-consistency (satellite) ----------
 
 def test_write_json_exclusive_exactly_one_winner(tmp_path):
-    p = str(tmp_path / "marker")
-    assert write_json_exclusive(p, {"who": "first"}) is True
-    assert write_json_exclusive(p, {"who": "second"}) is False
-    with open(p) as f:
-        assert json.load(f)["who"] == "first"
+    test_lease.check_exclusive_retirement_single_winner(
+        str(tmp_path / "marker"))
 
 
 def test_try_acquire_race_admits_exactly_one(tmp_path):
-    d = str(tmp_path)
-    wins = []
-    barrier = threading.Barrier(8)
-
-    def racer(k):
-        barrier.wait()
-        if fleet.try_acquire(d, 0, f"w{k}") is not None:
-            wins.append(k)
-
-    ts = [threading.Thread(target=racer, args=(k,)) for k in range(8)]
-    for t in ts:
-        t.start()
-    for t in ts:
-        t.join()
-    assert len(wins) == 1
-    rec = fleet.read_lease(d, 0)
-    assert rec["worker"] == f"w{wins[0]}"
+    test_lease.check_acquire_race_admits_exactly_one(
+        FLEET_OPS, str(tmp_path), 0)
 
 
 def test_torn_lease_expires_by_mtime_and_readmits_one(tmp_path):
     """SIGKILL between O_EXCL create and the owner write leaves an
     empty lease file: it must age by mtime, expire, and be re-acquired
     by exactly one of any number of racers."""
-    d = str(tmp_path)
-    open(fleet.lease_path(d, 0), "w").close()   # the torn lease
-    assert fleet.read_lease(d, 0) == {}         # unreadable != free
-    # young torn lease: NOT expirable (the owner may still be mid-write)
-    assert fleet.expire_lease(d, 0, timeout_s=60.0) is None
-    old = time.time() - 120
-    os.utime(fleet.lease_path(d, 0), (old, old))
-    assert fleet.expire_lease(d, 0, timeout_s=60.0) == {}
-    # the graveyard holds the evidence; the range is free again
-    assert os.listdir(os.path.join(d, fleet.GRAVEYARD))
-    wins = [w for w in range(4)
-            if fleet.try_acquire(d, 0, f"w{w}") is not None]
-    assert len(wins) == 1
+    test_lease.check_torn_lease_expires_by_mtime(FLEET_OPS, str(tmp_path), 0)
 
 
 def test_expired_then_renewed_lease_stays_owned(tmp_path):
     """A renewal that lands before the scheduler's expiry check keeps
     the lease: expiry reads the HEARTBEAT, not the acquire time."""
-    d = str(tmp_path)
-    rec = fleet.try_acquire(d, 0, "w0")
-    # age the acquire time far past any timeout...
-    write_json_atomic(fleet.lease_path(d, 0),
-                      dict(rec, acquired=time.time() - 999,
-                           renewed=time.time() - 999))
-    # ...then renew: the heartbeat bump must rescue it
-    assert fleet.renew(d, 0, rec) is True
-    assert fleet.expire_lease(d, 0, timeout_s=60.0) is None
-    # now let the heartbeat itself go stale: expiry evicts (kill=False:
-    # the holder is this test process)
-    write_json_atomic(fleet.lease_path(d, 0),
-                      dict(rec, renewed=time.time() - 999))
-    evicted = fleet.expire_lease(d, 0, timeout_s=60.0, kill=False)
-    assert evicted is not None and evicted["worker"] == "w0"
-    # the evicted owner's renew must now FAIL (stop-renewing contract)
-    assert fleet.renew(d, 0, rec) is False
-    # and exactly one racer re-acquires the freed range
-    wins = [w for w in range(4)
-            if fleet.try_acquire(d, 0, f"w{w}") is not None]
-    assert len(wins) == 1
+    test_lease.check_expired_then_renewed_stays_owned(
+        FLEET_OPS, str(tmp_path), 0)
 
 
 def test_release_ignores_foreign_lease(tmp_path):
-    d = str(tmp_path)
-    rec = fleet.try_acquire(d, 0, "w0")
-    fleet.release(d, 0, dict(rec, worker="imposter"))
-    assert fleet.read_lease(d, 0) is not None   # still held
-    fleet.release(d, 0, rec)
-    assert fleet.read_lease(d, 0) is None
+    test_lease.check_release_ignores_foreign(FLEET_OPS, str(tmp_path), 0)
 
 
 def test_reclaim_worker_leases_frees_only_that_pid(tmp_path):

@@ -1,0 +1,213 @@
+"""utils/lease.py: the audited file-lease primitive, extracted from
+pipeline/fleet.py in r16 so work-ranges (fleet) and serve jobs
+(pipeline/gateway.py spool) are two instantiations of ONE state
+machine.
+
+The crash-consistency scenarios here are the PR 13 suite — 8-racer
+single-winner acquire, torn-lease mtime expiry, expired-then-renewed
+exactly-one-owner, foreign-release no-op, exclusive retirement — but
+written as reusable checkers parameterized over the primitive's
+callables (`LeaseOps`).  tests/test_fleet.py runs the SAME checkers
+through fleet.py's integer-range wrappers, which is what makes the
+r16 extraction provably behavior-preserving: one scenario body, both
+key domains.
+"""
+
+import json
+import os
+import threading
+import time
+
+from ccsx_tpu.utils import lease as leaselib
+from ccsx_tpu.utils.journal import write_json_atomic, write_json_exclusive
+
+
+class LeaseOps:
+    """The five primitive callables a lease domain must provide, plus
+    the key spelling for that domain (string job-ids, integer ranges).
+
+    Each callable has the utils/lease.py signature with the key as the
+    second argument; GRAVEYARD is the eviction subdirectory name."""
+
+    def __init__(self, *, path, read, acquire, renew, expire, release,
+                 graveyard=leaselib.GRAVEYARD):
+        self.path = path
+        self.read = read
+        self.acquire = acquire
+        self.renew = renew
+        self.expire = expire
+        self.release = release
+        self.graveyard = graveyard
+
+
+LEASELIB_OPS = LeaseOps(
+    path=leaselib.lease_path, read=leaselib.read_lease,
+    acquire=leaselib.try_acquire, renew=leaselib.renew,
+    expire=leaselib.expire_lease, release=leaselib.release)
+
+
+# ---------- the shared scenario bodies ----------
+
+def check_acquire_race_admits_exactly_one(ops, d, key, racers=8):
+    """N threads race the kernel-arbitrated O_EXCL acquire: exactly one
+    wins, and the surviving record names that winner."""
+    wins = []
+    barrier = threading.Barrier(racers)
+
+    def racer(k):
+        barrier.wait()
+        if ops.acquire(d, key, f"w{k}") is not None:
+            wins.append(k)
+
+    ts = [threading.Thread(target=racer, args=(k,)) for k in range(racers)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(wins) == 1
+    rec = ops.read(d, key)
+    assert rec["worker"] == f"w{wins[0]}"
+
+
+def check_torn_lease_expires_by_mtime(ops, d, key):
+    """SIGKILL between O_EXCL create and the owner write leaves an
+    empty lease file: it must age by mtime, expire into the graveyard,
+    and be re-acquired by exactly one of any number of racers."""
+    open(ops.path(d, key), "w").close()         # the torn lease
+    assert ops.read(d, key) == {}               # unreadable != free
+    # young torn lease: NOT expirable (the owner may still be mid-write)
+    assert ops.expire(d, key, timeout_s=60.0) is None
+    old = time.time() - 120
+    os.utime(ops.path(d, key), (old, old))
+    assert ops.expire(d, key, timeout_s=60.0) == {}
+    # the graveyard holds the evidence; the key is free again
+    assert os.listdir(os.path.join(d, ops.graveyard))
+    wins = [w for w in range(4)
+            if ops.acquire(d, key, f"w{w}") is not None]
+    assert len(wins) == 1
+
+
+def check_expired_then_renewed_stays_owned(ops, d, key):
+    """A renewal that lands before the scheduler's expiry check keeps
+    the lease: expiry reads the HEARTBEAT, not the acquire time — and
+    once evicted, the old owner's renew must FAIL (stop-renewing
+    contract), freeing the key for exactly one re-acquirer."""
+    rec = ops.acquire(d, key, "w0")
+    # age the acquire time far past any timeout...
+    write_json_atomic(ops.path(d, key),
+                      dict(rec, acquired=time.time() - 999,
+                           renewed=time.time() - 999))
+    # ...then renew: the heartbeat bump must rescue it
+    assert ops.renew(d, key, rec) is True
+    assert ops.expire(d, key, timeout_s=60.0) is None
+    # now let the heartbeat itself go stale: expiry evicts (kill=False:
+    # the holder is this test process)
+    write_json_atomic(ops.path(d, key),
+                      dict(rec, renewed=time.time() - 999))
+    evicted = ops.expire(d, key, timeout_s=60.0, kill=False)
+    assert evicted is not None and evicted["worker"] == "w0"
+    assert ops.renew(d, key, rec) is False
+    wins = [w for w in range(4)
+            if ops.acquire(d, key, f"w{w}") is not None]
+    assert len(wins) == 1
+
+
+def check_release_ignores_foreign(ops, d, key):
+    rec = ops.acquire(d, key, "w0")
+    ops.release(d, key, dict(rec, worker="imposter"))
+    assert ops.read(d, key) is not None         # still held
+    ops.release(d, key, rec)
+    assert ops.read(d, key) is None
+
+
+def check_exclusive_retirement_single_winner(marker_path):
+    """The done-marker fence both domains retire through: os.link
+    publication admits exactly one writer; the loser must observe the
+    winner's record and yield (the zombie-replica double-emit guard)."""
+    assert write_json_exclusive(marker_path, {"who": "first"}) is True
+    assert write_json_exclusive(marker_path, {"who": "second"}) is False
+    with open(marker_path) as f:
+        assert json.load(f)["who"] == "first"
+
+
+# ---------- utils/lease.py instantiation (string keys) ----------
+
+def test_acquire_race_admits_exactly_one(tmp_path):
+    check_acquire_race_admits_exactly_one(LEASELIB_OPS, str(tmp_path), "j00001")
+
+
+def test_torn_lease_expires_by_mtime(tmp_path):
+    check_torn_lease_expires_by_mtime(LEASELIB_OPS, str(tmp_path), "j00001")
+
+
+def test_expired_then_renewed_stays_owned(tmp_path):
+    check_expired_then_renewed_stays_owned(LEASELIB_OPS, str(tmp_path), "j00001")
+
+
+def test_release_ignores_foreign(tmp_path):
+    check_release_ignores_foreign(LEASELIB_OPS, str(tmp_path), "j00001")
+
+
+def test_exclusive_retirement_single_winner(tmp_path):
+    check_exclusive_retirement_single_winner(str(tmp_path / "done.j1.json"))
+
+
+# ---------- string-domain specifics ----------
+
+def test_acquire_record_carries_extra(tmp_path):
+    d = str(tmp_path)
+    rec = leaselib.try_acquire(d, "j00007", "replica-a",
+                               extra={"port": 8851, "host": "h1"})
+    assert rec["key"] == "j00007" and rec["pid"] == os.getpid()
+    assert rec["port"] == 8851 and rec["host"] == "h1"
+    on_disk = leaselib.read_lease(d, "j00007")
+    assert on_disk == rec                       # fsynced before visible
+
+
+def test_renew_merges_extra_and_bumps_heartbeat(tmp_path):
+    d = str(tmp_path)
+    rec = leaselib.try_acquire(d, "r0", "replica-a", extra={"ready": False})
+    time.sleep(0.01)
+    assert leaselib.renew(d, "r0", rec, extra={"ready": True}) is True
+    got = leaselib.read_lease(d, "r0")
+    assert got["ready"] is True
+    assert got["renewed"] > rec["renewed"]
+
+
+def test_reclaim_pid_leases_frees_only_that_pid(tmp_path):
+    d = str(tmp_path)
+    rec0 = leaselib.try_acquire(d, "j00001", "dead")
+    rec2 = leaselib.try_acquire(d, "j00003", "dead")
+    leaselib.try_acquire(d, "j00002", "alive")
+    write_json_atomic(leaselib.lease_path(d, "j00001"), dict(rec0, pid=987654))
+    write_json_atomic(leaselib.lease_path(d, "j00003"), dict(rec2, pid=987654))
+    keys = ("j00001", "j00002", "j00003")
+    assert leaselib.reclaim_pid_leases(d, keys, 987654) == ["j00001", "j00003"]
+    assert leaselib.read_lease(d, "j00001") is None
+    assert leaselib.read_lease(d, "j00002") is not None
+    assert leaselib.read_lease(d, "j00003") is None
+
+
+def test_list_leases_skips_tmp_and_filters_prefix(tmp_path):
+    d = str(tmp_path)
+    leaselib.try_acquire(d, "j00001", "a")
+    leaselib.try_acquire(d, "r0", "b")
+    # a mid-write renew tmp file must never surface as a lease
+    open(os.path.join(d, "lease.r1.tmp"), "w").close()
+    allk = dict(leaselib.list_leases(d))
+    assert set(allk) == {"j00001", "r0"}
+    slots = dict(leaselib.list_leases(d, prefix="r"))
+    assert set(slots) == {"r0"}
+
+
+def test_graveyard_names_collide_safely(tmp_path):
+    """Repeated evictions of the same key must not clobber each other's
+    graveyard evidence (the `~k` collision suffix)."""
+    d = str(tmp_path)
+    for seq in range(3):
+        rec = leaselib.try_acquire(d, "j00001", f"w{seq}")
+        write_json_atomic(leaselib.lease_path(d, "j00001"),
+                          dict(rec, renewed=time.time() - 999))
+        assert leaselib.expire_lease(d, "j00001", timeout_s=1.0,
+                                     kill=False, seq=0) is not None
+    assert len(os.listdir(os.path.join(d, leaselib.GRAVEYARD))) == 3

@@ -77,7 +77,12 @@ def test_fused_refine_matches_host_loop(rng):
     assert metrics.windows == len(reqs)
 
 
-@pytest.mark.parametrize("mesh", [(4, 2), (8, 1)])
+@pytest.mark.parametrize("mesh", [
+    (4, 2),
+    # (8,1) is the same invariant on a second mesh shape; (4,2) keeps
+    # the fused-refine mesh A/B tier-1 (r16 budget audit)
+    pytest.param((8, 1), marks=pytest.mark.slow),
+])
 def test_fused_refine_under_mesh(rng, mesh):
     """The fused while_loop must survive GSPMD partitioning over the
     (data, pass) mesh bit-exactly (psums inside a while_loop body)."""

@@ -197,6 +197,9 @@ def test_concurrent_jobs_byte_identical_no_recompiles(corpus,
 
 # ---------- the HTTP job API ----------
 
+@pytest.mark.slow  # ~12s: HTTP rendering of the queue cap;
+# test_queue_full_core_raises keeps the cap tier-1 and the gateway
+# suite pins the HTTP Retry-After family (r16 budget audit)
 def test_queue_cap_429_with_retry_after(corpus, served):
     fa3, ref3, _, _ = corpus
     core, req = served(max_active=1, max_queue=1)
@@ -238,6 +241,9 @@ def test_submit_validation(served):
     assert code == 404
 
 
+@pytest.mark.slow  # ~7s: solo-serve cancel blast radius; the fleet
+# suite's cancel-at-renewal + sibling-byte-identity tests keep the
+# cancel drain path tier-1 (r16 budget audit)
 def test_cancel_mid_job_leaves_sibling_untouched(corpus, served):
     fa3, ref3, _, _ = corpus
     core, req = served(max_active=2)

@@ -1,0 +1,499 @@
+"""Serve replica fleet (r16): N warm servers over ONE job spool as a
+lease domain (pipeline/gateway.py spool protocol + pipeline/serve.py
+fleet mode + the `ccsx-tpu gateway` balancer).
+
+Load-bearing guarantees pinned here:
+
+* A job submitted into the shared spool is completed by a DIFFERENT
+  replica than the submitter, byte-identical to the sequential CLI
+  reference, with exactly one exclusive done marker.
+* A dead replica's job lease (stale heartbeat, dead pid) is expired by
+  a survivor — kill-before-steal, host-guarded — and the job completes
+  with zero loss.
+* Cross-replica cancel: a cancel marked on the spool record (the
+  gateway's DELETE path) is observed at the holder's next heartbeat
+  renewal and aborts ONLY that job (the PR 15 blast radius), rc 75.
+* The exclusive retirement fence admits exactly one emitter — a
+  zombie replica cannot double-emit a finished job.
+* The gateway health-routes on /readyz, answers 503 + Retry-After when
+  no replica is ready, serves fleet-aggregate ``ccsx_fleet_*`` gauges
+  on /metrics (schema cross-checked against the telemetry tuples both
+  directions), and discovers replicas through their slot leases —
+  deterministic base+slot ports, never guessing.
+* `ccsx-tpu top` expands a spool directory into its replica endpoints.
+* bench.py's serve-fleet vs_prev leg gates lost/duplicated jobs, byte
+  identity, steady-state recompiles, and the 20% throughput rule.
+
+The corpus reuses the 700 bp / 5-pass geometry of tests/test_serve.py
+so tier-1's process-wide jit cache is shared across the files.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ccsx_tpu import cli, exitcodes
+from ccsx_tpu.pipeline import gateway as spoolproto
+from ccsx_tpu.pipeline import supervisor
+from ccsx_tpu.pipeline.gateway import Gateway, _gateway_handler
+from ccsx_tpu.pipeline.serve import ServeCore, _serve_handler
+from ccsx_tpu.utils import faultinject, lease as leaselib, synth, telemetry
+from ccsx_tpu.utils.journal import write_json_atomic
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.disarm()
+    yield
+    faultinject.disarm()
+
+
+@pytest.fixture(autouse=True)
+def _fast_grace(monkeypatch):
+    monkeypatch.setenv("CCSX_DEADLINE_GRACE", "1")
+    monkeypatch.setenv("CCSX_FAULT_HANG_S", "60")
+    monkeypatch.setenv("CCSX_FAULT_STALL_S", "4")
+
+
+def _cfg(extra=()):
+    args = cli.build_parser().parse_args(["-A", "-m", "1000", *extra])
+    return cli.config_from_args(args)
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    """(3-hole input, its CLI reference output, 8-hole input, its CLI
+    reference output) — references computed by the plain CLI BEFORE
+    any ServeCore exists."""
+    tmp = tmp_path_factory.mktemp("serve_fleet")
+    rng = np.random.default_rng(0)
+
+    def make(n, path):
+        zs = [synth.make_zmw(rng, template_len=700, n_passes=5,
+                             movie="mv", hole=str(100 + h))
+              for h in range(n)]
+        path.write_text(synth.make_fasta(zs))
+
+    fa3, fa8 = tmp / "in3.fa", tmp / "in8.fa"
+    make(3, fa3)
+    make(8, fa8)
+    ref3, ref8 = tmp / "ref3.fa", tmp / "ref8.fa"
+    assert cli.main(["-A", "-m", "1000", "--batch", "on",
+                     str(fa3), str(ref3)]) == 0
+    assert cli.main(["-A", "-m", "1000", "--batch", "on",
+                     str(fa8), str(ref8)]) == 0
+    return (str(fa3), ref3.read_bytes(), str(fa8), ref8.read_bytes())
+
+
+@pytest.fixture
+def fleet_factory(tmp_path):
+    """Replica cores over one shared spool, torn down after the test."""
+    cores = []
+    spool = str(tmp_path / "spool")
+
+    def make(name, extra=(), **kw):
+        kw.setdefault("lease_timeout", 1.2)
+        kw.setdefault("poll_s", 0.1)
+        c = ServeCore(_cfg(extra), spool=spool, fleet=True,
+                      replica=name, **kw)
+        cores.append(c)
+        return c
+
+    yield spool, make
+    for c in cores:
+        c.close()
+
+
+def _wait_done(spool, jid, timeout=180.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        view = spoolproto.job_view(spool, jid)
+        if view and view["state"] in ("done", "failed", "cancelled",
+                                      "interrupted"):
+            return view
+        time.sleep(0.1)
+    raise AssertionError(
+        f"job {jid} not terminal: {spoolproto.job_view(spool, jid)}")
+
+
+# ---------- the spool protocol (no jax, no cores) ----------
+
+def test_submit_allocates_sequential_ids_and_spools_body(tmp_path):
+    spool = str(tmp_path)
+    j1 = spoolproto.submit_job(spool, input_path="/x/in.fa",
+                               overrides={})
+    j2 = spoolproto.submit_job(spool, input_path="/x/in2.fa",
+                               overrides={"deadline_s": 5})
+    assert (j1, j2) == ("j00001", "j00002")
+    assert spoolproto.job_view(spool, j1)["state"] == "queued"
+    assert spoolproto.spool_counts(spool)["queued"] == 2
+    # a request body is spooled + fsynced BEFORE the record exists
+    import io
+    j3 = spoolproto.submit_job(spool, body_stream=io.BytesIO(b">a\nACGT\n"),
+                               body_len=8, overrides={"format": "fasta"})
+    rec = spoolproto.read_job_record(spool, j3)
+    assert open(rec["input"], "rb").read() == b">a\nACGT\n"
+
+
+def test_exclusive_retirement_admits_one_emitter(tmp_path):
+    """The zombie double-emit guard: two replicas racing to retire one
+    job — the marker fence admits exactly one, and the loser can see
+    it lost (the signal to yield, not overwrite)."""
+    spool = str(tmp_path)
+    jid = spoolproto.submit_job(spool, input_path="/x/in.fa",
+                                overrides={})
+    assert spoolproto.retire_job(spool, jid, "done", 0, "A",
+                                 output="/x/a.fa") is True
+    assert spoolproto.retire_job(spool, jid, "done", 0, "B",
+                                 output="/x/b.fa") is False
+    view = spoolproto.job_view(spool, jid)
+    assert view["state"] == "done" and view["replica"] == "A"
+    assert view["output"] == "/x/a.fa"
+
+
+def test_cancel_and_deadline_marks(tmp_path):
+    spool = str(tmp_path)
+    jid = spoolproto.submit_job(spool, input_path="/x/in.fa",
+                                overrides={})
+    state, changed = spoolproto.mark_cancel(spool, jid)
+    assert changed and state == "cancelled"   # queued: dies unstarted
+    # idempotent: a second cancel reports unchanged
+    _, changed = spoolproto.mark_cancel(spool, jid)
+    assert not changed
+    with pytest.raises(KeyError):
+        spoolproto.mark_cancel(spool, "j99999")
+    assert spoolproto.mark_deadline(spool, jid, 3.5)
+    rec = spoolproto.read_job_record(spool, jid)
+    assert rec["overrides"]["deadline_s"] == 3.5
+
+
+def test_replica_slots_are_deterministic_and_discoverable(tmp_path):
+    """First-free-slot assignment + base-port arithmetic: the slot
+    lease IS the discovery record, so the gateway and `top` never
+    guess ports."""
+    spool = str(tmp_path)
+    k0, rec0 = spoolproto.acquire_replica_slot(
+        spool, "A", extra={"addr": "127.0.0.1", "port": 8850,
+                           "replica": "A", "ready": True})
+    k1, rec1 = spoolproto.acquire_replica_slot(
+        spool, "B", extra={"addr": "127.0.0.1", "port": 8851,
+                           "replica": "B", "ready": False})
+    assert (k0, k1) == (0, 1)
+    reps = spoolproto.discover_replicas(spool)
+    assert [r["name"] for r in reps] == ["A", "B"]
+    assert spoolproto.replica_endpoints(spool) == ["127.0.0.1:8850",
+                                                  "127.0.0.1:8851"]
+    # a dead replica's stale slot is reclaimed by the next joiner
+    write_json_atomic(leaselib.lease_path(spool, "r0"),
+                      dict(rec0, pid=987654,
+                           renewed=time.time() - 999))
+    k2, _ = spoolproto.acquire_replica_slot(spool, "C",
+                                            extra={"port": 8850},
+                                            lease_timeout=10.0)
+    assert k2 == 0
+    # `top` expands a spool directory into its slot-lease endpoints
+    assert telemetry.expand_sources([spool]) == ["127.0.0.1:8850",
+                                                 "127.0.0.1:8851"]
+
+
+def test_expand_sources_empty_fleet_renders_unreachable(tmp_path):
+    spool = str(tmp_path)
+    srcs = telemetry.expand_sources([spool])
+    assert len(srcs) == 1 and "<no-replicas>" in srcs[0]
+    # non-directory sources pass through untouched
+    assert telemetry.expand_sources(["127.0.0.1:9999"]) == [
+        "127.0.0.1:9999"]
+
+
+def test_fleet_series_schema_cross_check(tmp_path):
+    """Every FLEET_SERVE_GAUGES / FLEET_REPLICA_GAUGES name renders
+    exactly once as a ccsx_-prefixed family with one TYPE line — and
+    nothing renders that the schema tuples do not declare."""
+    spool = str(tmp_path)
+    spoolproto.submit_job(spool, input_path="/x/in.fa", overrides={})
+    spoolproto.acquire_replica_slot(
+        spool, "A", extra={"addr": "127.0.0.1", "port": 8850,
+                           "replica": "A", "ready": True,
+                           "pressure": 0.25, "leases": 1})
+    text = telemetry.render_fleet_series(
+        spoolproto.fleet_summary(spool))
+    declared = set(telemetry.FLEET_SERVE_GAUGES +
+                   telemetry.FLEET_REPLICA_GAUGES)
+    rendered = set()
+    for ln in text.splitlines():
+        if ln.startswith("# TYPE "):
+            name = ln.split()[2]
+            assert name.startswith("ccsx_")
+            rendered.add(name[len("ccsx_"):])
+    assert rendered == declared
+    for g in telemetry.FLEET_REPLICA_GAUGES:
+        assert f'ccsx_{g}{{replica="A"}}' in text
+
+
+def test_bench_compare_serve_fleet_gates(monkeypatch):
+    """The vs_prev serve-fleet leg: lost/duplicated jobs, byte
+    identity, and steady recompiles regress OUTRIGHT; throughput obeys
+    the 20% rule."""
+    import bench
+
+    def arts(cur, prev=None):
+        out = [("serve_fleet_r90.json", cur)]
+        if prev is not None:
+            out.append(("serve_fleet_r89.json", prev))
+        return out
+
+    good = {"zmws_per_sec": 10.0, "recompiles": 0, "lost_jobs": 0,
+            "duplicated_jobs": 0, "byte_identical": True, "ok": True}
+
+    def run(cur, prev=None):
+        monkeypatch.setattr(bench, "latest_serve_fleet_artifacts",
+                            lambda *a, **k: arts(cur, prev))
+        line, vp, reg = {}, {}, []
+        bench.compare_serve_fleet(line, None, vp, reg)
+        return line, vp, reg
+
+    _, _, reg = run(good, good)
+    assert reg == []
+    _, _, reg = run(dict(good, lost_jobs=1), good)
+    assert any("lost" in r for r in reg)
+    _, _, reg = run(dict(good, duplicated_jobs=2), good)
+    assert any("duplicated" in r for r in reg)
+    _, _, reg = run(dict(good, byte_identical=False), good)
+    assert any("byte-identical" in r for r in reg)
+    _, _, reg = run(dict(good, recompiles=3), good)
+    assert any("recompiles" in r for r in reg)
+    _, _, reg = run(dict(good, ok=False), good)
+    assert any("failed trials" in r for r in reg)
+    _, vp, reg = run(dict(good, zmws_per_sec=7.9), good)
+    assert any("throughput regression" in r for r in reg)
+    assert vp["serve_fleet_zmws_per_sec"]["prev"] == 10.0
+    _, _, reg = run(dict(good, zmws_per_sec=8.1), good)
+    assert reg == []
+
+
+def test_serve_replicas_flag_validation(capsys):
+    assert supervisor.shepherd_main(["--serve-replicas", "2"]) == 1
+    assert "--fleet SPOOL" in capsys.readouterr().err
+
+
+# ---------- cross-replica handoff (two warm cores, one spool) ----------
+
+def test_job_crosses_replicas_byte_identical(corpus, fleet_factory):
+    """THE tentpole pin: submit through replica A with A's admission
+    closed — B must lease the job from the shared spool, run it warm,
+    and retire it with exactly one done marker, byte-identical to the
+    CLI reference."""
+    fa3, ref3, _, _ = corpus
+    spool, make = fleet_factory
+    # A's scan tick is pushed past the test horizon: it accepts the
+    # submit but never leases work; B is the only puller
+    a = make("A", poll_s=30.0)
+    b = make("B")
+    h = a.submit(input_path=fa3, overrides={})
+    view = _wait_done(spool, h.id)
+    assert view["state"] == "done" and view["replica"] == "B"
+    assert open(view["output"], "rb").read() == ref3
+    # exactly one done marker; the lease was released after it
+    assert os.path.exists(spoolproto.done_marker_path(spool, h.id))
+    assert leaselib.read_lease(spool, h.id) is None
+    # the submitter's view agrees (spool-wide state, not local memory)
+    assert a.wait(h.id, timeout=10) == "done"
+
+
+def test_dead_replica_job_requeues_to_survivor(corpus, fleet_factory):
+    """Replica death = requeue by construction: a job leased by a dead
+    pid (stale heartbeat) is expired by the survivor's scan —
+    kill-before-steal with the dead-pid SIGKILL a no-op — and
+    completes with zero loss."""
+    fa3, ref3, _, _ = corpus
+    spool, make = fleet_factory
+    jid = spoolproto.submit_job(spool, input_path=fa3, overrides={})
+    # forge the dead replica's leavings: lease held by pid 987654,
+    # heartbeat long stale (own host, so the kill path is exercised
+    # against a pid that does not exist)
+    rec = leaselib.try_acquire(spool, jid, "dead-replica",
+                               extra={"host": "nosuchhost.invalid"})
+    assert rec is not None
+    write_json_atomic(leaselib.lease_path(spool, jid),
+                      dict(rec, pid=987654, renewed=time.time() - 999))
+    s = make("survivor")
+    view = _wait_done(spool, jid)
+    assert view["state"] == "done" and view["replica"] == "survivor"
+    assert open(view["output"], "rb").read() == ref3
+    # the dead holder's lease went through the graveyard, not deletion
+    assert os.listdir(os.path.join(spool, leaselib.GRAVEYARD))
+    del s
+
+
+def test_cross_replica_cancel_lands_at_renewal(corpus, fleet_factory):
+    """The gateway cancel path: a cancel marked on the SPOOL RECORD
+    (not the holder's HTTP API) is observed at the holder's next
+    heartbeat renewal, aborts rc 75 through the job's own guard, and
+    leaves the sibling job untouched (PR 15 blast radius)."""
+    fa3, ref3, _, _ = corpus
+    spool, make = fleet_factory
+    c = make("A", max_active=2)
+    victim = c.submit(input_path=fa3,
+                      overrides={"faults": "stall@1"})
+    sibling = c.submit(input_path=fa3, overrides={})
+    deadline = time.monotonic() + 60
+    while (leaselib.read_lease(spool, victim.id) is None
+           and time.monotonic() < deadline):
+        time.sleep(0.05)  # wait for A to lease the victim
+    state, changed = spoolproto.mark_cancel(spool, victim.id)
+    assert changed
+    view = _wait_done(spool, victim.id)
+    assert view["state"] == "cancelled"
+    assert view["rc"] == exitcodes.RC_INTERRUPTED
+    sview = _wait_done(spool, sibling.id)
+    assert sview["state"] == "done"
+    assert open(sview["output"], "rb").read() == ref3
+
+
+def test_cancel_queued_job_retired_without_running(fleet_factory):
+    """A job cancelled while still queued is retired 'cancelled' by
+    whichever replica sees it first — it never runs."""
+    spool, make = fleet_factory
+    os.makedirs(spool, exist_ok=True)
+    jid = spoolproto.submit_job(spool, input_path="/nonexistent.fa",
+                                overrides={})
+    spoolproto.mark_cancel(spool, jid)
+    make("A", max_active=1)   # the scan retires it before any run
+    view = _wait_done(spool, jid, timeout=30)
+    assert view["state"] == "cancelled"
+    assert view["rc"] == exitcodes.RC_INTERRUPTED
+
+
+# ---------- the gateway (HTTP balancer over the spool) ----------
+
+def _http(port):
+    base = f"http://127.0.0.1:{port}"
+
+    def req(method, path, data=None):
+        r = urllib.request.Request(base + path, data=data,
+                                   method=method)
+        if data is not None:
+            r.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(r, timeout=30) as resp:
+                return resp.status, resp.read(), dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, e.read(), dict(e.headers)
+
+    return req
+
+
+def test_gateway_503_retry_after_when_no_replica_ready(tmp_path):
+    """An empty fleet (or all replicas draining) answers POST /jobs
+    with 503 + Retry-After, never enqueueing into a spool nobody
+    serves."""
+    spool = str(tmp_path / "spool")
+    os.makedirs(spool)
+    gw = Gateway(spool, probe_s=0.05)
+    srv = telemetry.TelemetryServer(
+        None, 0, host="127.0.0.1", handler=_gateway_handler(),
+        attrs={"ccsx_gateway": gw, "ccsx_ready": gw.readiness})
+    try:
+        req = _http(srv.port)
+        code, body, hdrs = req("POST", "/jobs",
+                               json.dumps({"input": "/x.fa"}).encode())
+        assert code == 503
+        assert hdrs.get("Retry-After") == "5"
+        assert spoolproto.list_job_ids(spool) == []
+        code, body, _ = req("GET", "/readyz")
+        assert code == 503 and json.loads(body)["ready"] is False
+        # liveness stays 200 (the gateway itself is up)
+        code, _, _ = req("GET", "/healthz")
+        assert code == 200
+    finally:
+        srv.close()
+
+
+def test_gateway_routes_submit_to_ready_replica(corpus, fleet_factory):
+    """End to end through HTTP: replica serves /readyz, gateway
+    discovers it via its slot lease, accepts the POST, the replica
+    completes it, the gateway serves the output bytes and the
+    ccsx_fleet_* gauges."""
+    fa3, ref3, _, _ = corpus
+    spool, make = fleet_factory
+    core = make("A")
+    rsrv = telemetry.TelemetryServer(
+        core.metrics, 0, host="127.0.0.1", handler=_serve_handler(),
+        attrs={"ccsx_core": core, "ccsx_ready": core.readiness})
+    core.register_replica()
+    core.set_advertised(rsrv.port)
+    gw = Gateway(spool, probe_s=0.05)
+    gsrv = telemetry.TelemetryServer(
+        None, 0, host="127.0.0.1", handler=_gateway_handler(),
+        attrs={"ccsx_gateway": gw, "ccsx_ready": gw.readiness})
+    try:
+        req = _http(gsrv.port)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            code, body, _ = req("GET", "/readyz")
+            if code == 200:
+                break
+            time.sleep(0.1)
+        assert code == 200, body
+        code, body, _ = req("POST", "/jobs",
+                            json.dumps({"input": fa3}).encode())
+        assert code == 201, body
+        jid = json.loads(body)["id"]
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            code, body, _ = req("GET", f"/jobs/{jid}")
+            if json.loads(body).get("state") == "done":
+                break
+            time.sleep(0.2)
+        assert json.loads(body)["state"] == "done", body
+        code, out, _ = req("GET", f"/jobs/{jid}/output")
+        assert code == 200 and out == ref3
+        # discovery + autoscale gauges
+        code, body, _ = req("GET", "/replicas")
+        reps = json.loads(body)["replicas"]
+        assert [r["name"] for r in reps] == ["A"]
+        assert reps[0]["port"] == rsrv.port
+        code, body, _ = req("GET", "/metrics")
+        text = body.decode()
+        for g in telemetry.FLEET_SERVE_GAUGES:
+            assert f"ccsx_{g}" in text
+        assert "ccsx_fleet_jobs_retired 1" in text
+        # DELETE of a retired job conflicts (409), unknown is 404
+        code, _, _ = req("DELETE", f"/jobs/{jid}")
+        assert code == 409
+        code, _, _ = req("DELETE", "/jobs/j99999")
+        assert code == 404
+    finally:
+        gsrv.close()
+        rsrv.close()
+
+
+# ---------- fan-out (slow: full e2e through the range queue) ----------
+
+@pytest.mark.slow  # ~30s: cross-replica fan-out e2e; the handoff,
+# requeue and cancel pins above keep the lease domain tier-1
+def test_fanout_job_splits_and_merges_byte_identical(corpus,
+                                                     fleet_factory):
+    """A job above --fanout-holes splits through the PR 13 range queue
+    under the holder's warm runtime, helpers pull ranges from sibling
+    replicas, and the merged output is byte-identical to the CLI
+    reference."""
+    _, _, fa8, ref8 = corpus
+    spool, make = fleet_factory
+    a = make("A", fanout_holes=4, fanout_ranges=3)
+    b = make("B", fanout_holes=4, fanout_ranges=3)
+    h = a.submit(input_path=fa8, overrides={})
+    view = _wait_done(spool, h.id, timeout=300)
+    assert view["state"] == "done", view
+    assert open(view["output"], "rb").read() == ref8
+    # the fan-out scratch dir is cleaned up after the merge
+    assert not os.path.exists(os.path.join(spool, f"fanout.{h.id}"))
+    # the spool record advertised the split (helper discovery channel)
+    assert (spoolproto.read_job_record(spool, h.id) or {}).get("fanout")
+    del b

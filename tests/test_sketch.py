@@ -89,6 +89,8 @@ def test_screen_and_seed_device_match_host(rng):
                     == np.asarray(hit.line)).all()
 
 
+@pytest.mark.slow  # ~9s boundary A/B; screen_and_seed_device_match_host
+# pins the device/host routing parity tier-1 (r16 budget audit)
 def test_seed_device_crossover_boundary(rng):
     """PairExecutor routing at the --seed-device-min-t boundary:
     templates one below / at / above the crossover produce identical
@@ -300,6 +302,8 @@ def test_injected_oom_on_sketch_wave_recovers(rng):
     assert m.pairs_prefiltered >= 1  # the wrong-strand pair still died
 
 
+@pytest.mark.slow  # ~11s warm-routing A/B; serve's zero-recompile pin and
+# screen_and_seed_device_match_host stay tier-1 (r16 budget audit)
 def test_warm_covers_prefilter_shapes(rng):
     """PairExecutor.warm precompiles the pre-alignment executables
     alongside the pair fills (inline when no compiler is attached),

@@ -111,6 +111,8 @@ def test_shepherd_restarts_sigkilled_rank_and_merges(corpus4, tmp_path,
     assert "rank_death" in log1
 
 
+@pytest.mark.slow  # ~25s: full-shepherd budget-accounting A/B; the
+# sigkilled-restart-and-merge e2e stays tier-1 (r16 budget audit)
 def test_shepherd_drained_rank_is_not_charged_a_restart(corpus4,
                                                         tmp_path,
                                                         capsys):
