@@ -21,6 +21,7 @@ Recalibrate with:  python bench.py --calibrate
 
 import json
 import os
+import shutil
 import sys
 import time
 
@@ -715,7 +716,18 @@ def main():
                 return line, err
         return None, err
 
-    line, dev_err = attempt({}, budget)
+    # arm the flight recorder for the device attempt: a hung-then-
+    # killed attempt leaves DIR/blackbox.<pid>.bin (mmap ring, survives
+    # SIGKILL) naming the in-flight dispatch — the artifact the hang
+    # branch below links next to device_attempt_report.  Per-run
+    # subdirectory, cleared first: the base dir persists across runs,
+    # and a stale ring from an earlier bench (or a concurrent one)
+    # must not be linked as THIS attempt's forensics
+    bb_base = os.environ.get("CCSX_BLACKBOX") or os.path.join(
+        _HERE, "benchmarks", "bench_blackbox")
+    bb_dir = os.path.join(bb_base, f"run.{os.getpid()}")
+    shutil.rmtree(bb_dir, ignore_errors=True)
+    line, dev_err = attempt({"CCSX_BLACKBOX": bb_dir}, budget)
     if line is None:
         print("[bench] retrying on CPU with reduced e2e", file=sys.stderr)
         line, _ = attempt({"JAX_PLATFORMS": "cpu",
@@ -734,6 +746,22 @@ def main():
                 d = json.loads(line)
                 d["degraded"] = "tpu attempt hung; CPU-fallback numbers"
                 d["device_attempt"] = device_attempt_report(dev_err)
+                import glob as globmod
+
+                try:
+                    rings = sorted(
+                        globmod.glob(os.path.join(bb_dir,
+                                                  "blackbox.*.bin")),
+                        key=os.path.getmtime)
+                except OSError:
+                    # a ring vanished between glob and stat — forensics
+                    # are best-effort, never bench-fatal
+                    rings = []
+                if rings:
+                    # the hung attempt's black-box ring: render with
+                    # `ccsx-tpu blackbox <path>`
+                    d["device_attempt"]["blackbox"] = os.path.relpath(
+                        rings[-1], _HERE)
                 line = json.dumps(d)
             except ValueError:
                 pass

@@ -183,7 +183,11 @@ ccsx-tpu report <jsonl>.. (self-contained HTML run report from trace/
                            metrics JSONL: timeline strip, group
                            compile/execute table, stage breakdown,
                            occupancy tiles, stall/recovery log,
-                           ETA-vs-actual curve; -o <out.html>)
+                           ETA-vs-actual curve; -o <out.html>.
+                           With --fleet <dir>: stitch a fleet/spool
+                           dir's per-process JSONL into ONE merged
+                           wall-aligned timeline per job, keyed by
+                           the correlation id minted at submission)
 ccsx-tpu serve [opts]     (resident multi-tenant consensus server:
                            one warm runtime — executors, warmup
                            compiles, tracer — shared by jobs
@@ -220,6 +224,14 @@ ccsx-tpu gateway --spool S (thin balancer over a serve fleet: POST
                            replica admission-window pressure — on
                            /metrics; no jax: keeps routing while
                            every replica's accelerator is wedged)
+ccsx-tpu blackbox <path>.. (render crash-persistent flight-recorder
+                           dumps: each process with CCSX_BLACKBOX=DIR
+                           set mirrors its last events into an mmap
+                           ring DIR/blackbox.<pid>.bin that survives
+                           SIGKILL; headlines the in-flight job/range/
+                           span at death, then the event tail.  A
+                           directory argument expands to every ring
+                           inside it; --tail N)
 """
 
 
@@ -672,6 +684,13 @@ def main(argv: Optional[list] = None) -> int:
         from ccsx_tpu.pipeline.gateway import gateway_main
 
         return gateway_main(argv[1:])
+    if argv and argv[0] == "blackbox":
+        # crash-persistent flight-recorder dump renderer (utils/
+        # blackbox.py) — no jax: the whole point is reading a DEAD
+        # process' last events from a possibly-wedged host
+        from ccsx_tpu.utils import blackbox
+
+        return blackbox.blackbox_main(argv[1:])
     args = build_parser().parse_args(argv)
     if args.help:
         return usage()  # rc 1, like the reference (main.c:761)

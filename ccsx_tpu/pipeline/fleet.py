@@ -83,13 +83,18 @@ def table_hash(in_path: str, n_holes: int,
 
 def init_fleet(d: str, in_path: str, out_path: str, n_holes: int,
                m: int, lease_timeout: float,
-               forward_args: Optional[list] = None) -> dict:
+               forward_args: Optional[list] = None,
+               cid: Optional[str] = None) -> dict:
     """Create (or re-open) the fleet directory and its state file.
 
     Re-opening requires an identical range table — a leftover fleet
     dir from a different split must be removed by the operator, not
     silently inherited (its journals and markers describe other
-    ranges)."""
+    ranges).  ``cid`` is the submitting job's correlation id: it rides
+    the state file so every worker pulling a range of this fan-out —
+    including sibling replicas helping — stamps its spans/metrics with
+    the SAME id the gateway minted (deliberately outside the table
+    hash: correlation is observability, not range identity)."""
     from ccsx_tpu.io import bamindex
 
     ranges = bamindex.split_ranges(n_holes, m)
@@ -98,6 +103,8 @@ def init_fleet(d: str, in_path: str, out_path: str, n_holes: int,
              "table": table_hash(in_path, n_holes, ranges),
              "lease_timeout": lease_timeout,
              "forward": list(forward_args or [])}
+    if cid:
+        state["cid"] = cid
     os.makedirs(os.path.join(d, GRAVEYARD), exist_ok=True)
     path = os.path.join(d, FLEET_STATE)
     if os.path.exists(path):
@@ -138,14 +145,20 @@ def read_lease(d: str, i: int) -> Optional[dict]:
     return leaselib.read_lease(d, str(i))
 
 
-def try_acquire(d: str, i: int, worker: str) -> Optional[dict]:
+def try_acquire(d: str, i: int, worker: str,
+                cid: Optional[str] = None) -> Optional[dict]:
     """Acquire lease i, or None if it is held.  ``O_CREAT|O_EXCL`` is
     the arbitration: of any number of racers the kernel admits exactly
     one, with no read-check-write window.  The owner record (worker,
-    pid, heartbeat) is fsynced into the fresh file; a SIGKILL between
-    create and write leaves a TORN lease, which the scheduler ages by
-    file mtime and expires like any stale one."""
-    return leaselib.try_acquire(d, str(i), worker, extra={"range": i})
+    pid, heartbeat, the fan-out's correlation id when known) is
+    fsynced into the fresh file; a SIGKILL between create and write
+    leaves a TORN lease, which the scheduler ages by file mtime and
+    expires like any stale one."""
+    extra = {"range": i}
+    if cid:
+        extra["cid"] = cid
+    return leaselib.try_acquire(d, str(i), worker, extra=extra,
+                                kind="range")
 
 
 def renew(d: str, i: int, rec: dict) -> bool:
@@ -255,6 +268,30 @@ def run_range(d: str, state: dict, cfg: CcsConfig, i: int,
     ``_JobRuntime``): a serve replica running a fan-out range passes it
     so the range reuses the replica's compiled executables and fair
     admission window instead of cold-starting a tracer per range."""
+    from ccsx_tpu.utils import blackbox, trace
+
+    cid = state.get("cid")
+    with trace.cid_scope(cid):
+        # the inflight/done pair is what names this range in a
+        # SIGKILLed worker's black-box dump; the done note rides a
+        # finally so an exception cannot leave the range open in a
+        # live worker's ring
+        blackbox.note("inflight", what="range", id=i,
+                      **({"cid": cid} if cid else {}))
+        rc: Optional[int] = None
+        try:
+            rc = _run_range(d, state, cfg, i, worker,
+                            inflight=inflight, shared=shared)
+            return rc
+        finally:
+            blackbox.note("done", what="range", id=i,
+                          **({"rc": rc} if rc is not None
+                             else {"error": True}))
+
+
+def _run_range(d: str, state: dict, cfg: CcsConfig, i: int,
+               worker: str, inflight: Optional[int] = None,
+               shared=None) -> int:
     from ccsx_tpu.pipeline.batch import drive_batched, mesh_precheck
     from ccsx_tpu.utils.device import resolve_device
 
@@ -263,6 +300,7 @@ def run_range(d: str, state: dict, cfg: CcsConfig, i: int,
     lo, hi = state["ranges"][i]
     metrics = Metrics(verbose=cfg.verbose, stream=cfg.metrics_stream())
     metrics.holes_total = hi - lo
+    metrics.cid = state.get("cid")
     try:
         stream = _open_range_stream(in_path, cfg, lo, hi, metrics)
     except (OSError, RuntimeError) as e:
@@ -359,7 +397,8 @@ def run_fleet_worker(d: str, cfg: CcsConfig,
                     continue
                 all_done = False
                 try:
-                    rec = try_acquire(d, i, worker)
+                    rec = try_acquire(d, i, worker,
+                                      cid=state.get("cid"))
                 except FileNotFoundError:
                     # the fleet dir vanished: the scheduler retired the
                     # whole queue, merged, and cleaned up while we were

@@ -131,6 +131,7 @@ def job_view(spool: str, jid: str) -> Optional[dict]:
         "submitted_at": rec.get("submitted_at"),
         "cancel": bool(rec.get("cancel")),
         "fanout": rec.get("fanout"),
+        "cid": rec.get("cid"),
     }
     if marker:
         view.update({
@@ -198,8 +199,16 @@ def submit_job(spool: str, input_path: Optional[str] = None,
             os.fsync(f.fileno())
     if not input_path:
         raise ValueError("job needs an input path or a request body")
+    # the fleet-wide correlation id is minted HERE, at submission: the
+    # one writer that exists before any replica touches the job.  It
+    # rides the spool record -> the job lease -> the fan-out fleet
+    # state -> every span/metrics event any process emits for this job
+    # (utils/trace.cid_scope), and is what `ccsx-tpu report --fleet`
+    # stitches the per-process timelines by.
+    cid = f"c{os.urandom(6).hex()}"
     rec = {"version": 1, "input": input_path, "overrides": overrides,
-           "submitted_at": time.time(), "submitter": os.getpid()}
+           "submitted_at": time.time(), "submitter": os.getpid(),
+           "cid": cid}
     existing = list_job_ids(spool)
     seq = (max((int(j[1:]) for j in existing), default=0)) + 1
     while True:
@@ -270,7 +279,8 @@ def acquire_replica_slot(spool: str, worker: str,
         leaselib.expire_lease(spool, key, lease_timeout, kill=False,
                               seq=k)
         rec = leaselib.try_acquire(spool, key, worker,
-                                   extra=dict(extra or {}, slot=k))
+                                   extra=dict(extra or {}, slot=k),
+                                   kind="slot")
         if rec is not None:
             return k, rec
     raise RuntimeError(f"no free replica slot in {spool} "
@@ -404,6 +414,40 @@ class Gateway:
     def summary(self) -> dict:
         return fleet_summary(self.spool, replicas=self.replicas())
 
+    def fleet_hist(self) -> dict:
+        """Fleet-merged latency histograms: every reachable replica's
+        /progress snapshot carries its ``hist`` families; per-`le`
+        counts are SUMMED per (family, label) — quantiles do not
+        compose, buckets do (utils/metrics.merge_hist).  The merged
+        set is what the gateway's /metrics exposes next to the
+        ccsx_fleet_* autoscale gauges, so one scrape sees fleet-wide
+        queue-wait/job-wall distributions and their SLO burn."""
+        from ccsx_tpu.utils.metrics import merge_hist
+
+        per: dict = {}
+        for r in self.replicas():
+            if not (r.get("reachable") and r.get("port")):
+                continue
+            url = f"http://{r['addr']}:{r['port']}/progress"
+            try:
+                with urllib.request.urlopen(
+                        url, timeout=self.timeout) as resp:
+                    snap = json.loads(resp.read() or b"{}")
+            except (OSError, ValueError):
+                continue
+            hist = snap.get("hist")
+            if not isinstance(hist, dict):
+                continue
+            for fam, series in hist.items():
+                if not isinstance(series, dict):
+                    continue
+                for label, s in series.items():
+                    per.setdefault(fam, {}).setdefault(
+                        label, []).append(s)
+        return {fam: {label: merge_hist(snaps)
+                      for label, snaps in series.items()}
+                for fam, series in per.items()}
+
     def submit(self, input_path=None, body_stream=None, body_len=0,
                overrides=None) -> str:
         ready, reason = self.readiness()
@@ -485,6 +529,11 @@ def _gateway_handler():
                                     {"ready": ready, "reason": reason})
                 elif path == "/metrics":
                     body = telemetry.render_fleet_series(gw.summary())
+                    hist = gw.fleet_hist()
+                    hlines = (telemetry.hist_lines(hist)
+                              + telemetry.slo_burn_lines(hist))
+                    if hlines:
+                        body += "\n".join(hlines) + "\n"
                     self._send(200, body,
                                "text/plain; version=0.0.4; "
                                "charset=utf-8")

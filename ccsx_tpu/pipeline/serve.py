@@ -99,7 +99,7 @@ from ccsx_tpu.utils import faultinject
 from ccsx_tpu.utils import lease as leaselib
 from ccsx_tpu.utils.drain import FlagGuard
 from ccsx_tpu.utils.journal import write_json_atomic
-from ccsx_tpu.utils.metrics import Metrics
+from ccsx_tpu.utils.metrics import Metrics, size_class
 
 STATE_FILE = "state.json"
 # terminal-for-this-process states ("interrupted" is resumable by a
@@ -287,6 +287,11 @@ class Job:
         self.lease: Optional[dict] = None
         self.lease_lost = False
         self.fanout_holes_n = 0
+        # the fleet-wide correlation id (minted at submission —
+        # gateway.submit_job for spooled jobs, ServeCore.submit for
+        # solo ones); every span/metrics event this job causes in any
+        # process carries it
+        self.cid: Optional[str] = None
 
     def info(self) -> dict:
         snap = self.snap
@@ -300,6 +305,7 @@ class Job:
             "submitted_at": self.submitted_at,
             "started_at": self.started_at,
             "finished_at": self.finished_at,
+            "cid": self.cid,
         }
         if snap:
             d["metrics"] = {k: snap.get(k) for k in (
@@ -381,8 +387,12 @@ class ServeCore:
         self.window = FairWindow(int(getattr(cfg, "zmw_microbatch", 64)))
         # the server tracer: installed for the process lifetime, group
         # table in self.metrics — /progress exposes the cumulative
-        # compile counters the zero-recompile test reads
-        self._tracer = trace.Tracer(None,
+        # compile counters the zero-recompile test reads.  A --trace
+        # path makes it a per-PROCESS span JSONL (every job's spans,
+        # cid-stamped) — give each fleet replica its own path and
+        # `ccsx-tpu report --fleet <spool>` stitches them into one
+        # timeline per job
+        self._tracer = trace.Tracer(cfg.trace_path or None,
                                     stall_timeout=cfg.stall_timeout_s,
                                     metrics=self.metrics)
         trace.install(self._tracer)
@@ -465,6 +475,9 @@ class ServeCore:
         if not input_path:
             raise ValueError("job needs an input path or a request body")
         job = self._build_job(jid, input_path, overrides)
+        # solo jobs never pass through the gateway: mint their
+        # correlation id here, at the same point in the lifecycle
+        job.cid = f"c{os.urandom(6).hex()}"
         with self._lock:
             self._jobs[jid] = job
             self._queue.append(job)
@@ -550,6 +563,10 @@ class ServeCore:
                 job.state = "running"
                 if job.started_at is None:
                     job.started_at = time.time()
+                    self.metrics.observe(
+                        "queue_wait_s",
+                        max(0.0, job.started_at - job.submitted_at),
+                        size_class(job.fanout_holes_n))
                 self._n_running += 1
                 t = threading.Thread(target=self._job_main, args=(job,),
                                      daemon=True,
@@ -558,6 +575,8 @@ class ServeCore:
                 t.start()
 
     def _job_main(self, job: Job) -> None:
+        from ccsx_tpu.utils import blackbox, trace
+
         stop: Optional[threading.Event] = None
         try:
             if self.fleet and job.lease is not None:
@@ -566,7 +585,22 @@ class ServeCore:
                                      args=(job, stop), daemon=True,
                                      name=f"ccsx-renew-{job.id}")
                 t.start()
-            self._run_job(job)
+            # every span/metrics record the job causes carries its
+            # correlation id; the black-box inflight/done pair is what
+            # names this job in a SIGKILLed replica's dump
+            with trace.cid_scope(job.cid):
+                blackbox.note("inflight", what="job", id=job.id,
+                              **({"cid": job.cid} if job.cid else {}))
+                err = True
+                try:
+                    self._run_job(job)
+                    err = False
+                finally:
+                    # pair the note even when _run_job raises: only a
+                    # genuine process death may leave the job open in
+                    # a live replica's ring
+                    blackbox.note("done", what="job", id=job.id,
+                                  **({"error": True} if err else {}))
         finally:
             if stop is not None:
                 stop.set()
@@ -638,7 +672,9 @@ class ServeCore:
                 self.spool, jid, self.replica,
                 extra={"replica": self.replica, "host": self.hostname,
                        "addr": self.addr,
-                       "port": self.advertised_port})
+                       "port": self.advertised_port,
+                       "cid": rec.get("cid")},
+                kind="job")
             if lease_rec is not None:
                 self._admit_fleet_job(jid, rec, lease_rec)
         if self._fleet_capacity() > 0:
@@ -684,6 +720,13 @@ class ServeCore:
             leaselib.release(self.spool, jid, lease_rec)
             return
         job.lease = lease_rec
+        job.cid = rec.get("cid")
+        try:
+            # queue-wait must measure from SUBMISSION, not from this
+            # replica's admit tick
+            job.submitted_at = float(rec["submitted_at"])
+        except (KeyError, TypeError, ValueError):
+            pass
         if self.fanout_holes > 0:
             try:
                 from ccsx_tpu.pipeline.run import count_raw_holes
@@ -753,10 +796,12 @@ class ServeCore:
         d = self._fanout_dir(job.id)
         metrics = Metrics(verbose=0, stream=None)
         metrics.job = job.id
+        metrics.cid = job.cid
         job.metrics = metrics
         try:
             state = fleet.init_fleet(d, job.in_path, job.out_path, n,
-                                     m, self.lease_timeout)
+                                     m, self.lease_timeout,
+                                     cid=job.cid)
         except (OSError, ValueError) as e:
             job.error = f"fan-out init failed: {e}"
             self._finish(job, "failed", 1)
@@ -784,7 +829,8 @@ class ServeCore:
                             distributed.done_path(job.out_path, i)):
                         continue
                     pending = True
-                    lr = fleet.try_acquire(d, i, self.replica)
+                    lr = fleet.try_acquire(d, i, self.replica,
+                                           cid=job.cid)
                     if lr is None:
                         # a helper (or a dead helper) holds it: expiry
                         # keeps a killed sibling from pinning a range
@@ -907,7 +953,8 @@ class ServeCore:
                             distributed.done_path(state["output"], i)):
                         continue
                     try:
-                        lr = fleet.try_acquire(d, i, self.replica)
+                        lr = fleet.try_acquire(d, i, self.replica,
+                                               cid=state.get("cid"))
                     except FileNotFoundError:
                         return  # holder merged and cleaned up: done
                     if lr is None:
@@ -955,6 +1002,7 @@ class ServeCore:
             token = faultinject.scope_arm(job.faults)
             metrics = Metrics(verbose=0, stream=None)
             metrics.job = job.id
+            metrics.cid = job.cid
             job.metrics = metrics
             adm = JobAdmission(self.window, job.id)
             rt = _JobRuntime(self.warm, self.warm_cache, guard, adm)
@@ -1029,6 +1077,15 @@ class ServeCore:
             job.finished_at = time.time()
             if state == "done":
                 self._completed_any = True
+            wall = (job.finished_at - job.started_at
+                    if job.started_at is not None else None)
+        if wall is not None:
+            self.metrics.observe("job_wall_s", max(0.0, wall),
+                                 size_class(job.fanout_holes_n))
+        if job.snap and job.snap.get("hist"):
+            # fold the job's fault-domain observations (first dispatch
+            # etc.) into the server-lifetime families /metrics serves
+            self.metrics.merge_hists(job.snap["hist"])
         if self.fleet and job.lease is not None:
             self._retire_fleet_job(job, state, rc)
 

@@ -159,6 +159,25 @@ def _poll_healthz(port: int, timeout: float = 0.5) -> Optional[str]:
         return None
 
 
+def _blackbox_hint(pid: Optional[int], *dirs: Optional[str]) -> None:
+    """Point the reap log at a dead child's black-box ring when one
+    exists (the CCSX_BLACKBOX flight recorder, utils/blackbox.py): the
+    supervisor is the first reader of a SIGKILL, and this one line is
+    the hop from 'pid N died' to WHAT it was doing when it died."""
+    if not pid:
+        return
+    from ccsx_tpu.utils import blackbox
+
+    for bd in (os.environ.get(blackbox.ENV_DIR),) + dirs:
+        if not bd:
+            continue
+        p = blackbox.box_path(bd, pid)
+        if os.path.exists(p):
+            print(f"[ccsx-tpu] black box for pid {pid}: "
+                  f"`ccsx-tpu blackbox {p}`", file=sys.stderr)
+            return
+
+
 def shepherd_run(in_path: str, out_path: str, hosts: int,
                  forward_args: List[str],
                  journal: Optional[str] = None,
@@ -246,8 +265,10 @@ def shepherd_run(in_path: str, out_path: str, hosts: int,
             st.log = None
 
     def schedule_restart(st: _Rank, reason: str) -> None:
+        pid = st.proc.pid if st.proc is not None else None
         close_log(st)
         st.proc = None
+        _blackbox_hint(pid)
         if st.attempts >= max_restarts:
             st.failed = (f"rank {st.rank} {reason} and exhausted its "
                          f"{max_restarts} restart(s)")
@@ -581,6 +602,7 @@ def fleet_run(in_path: str, out_path: str, cfg, hosts: int,
                               f"died (rc {rc}); requeued range(s) "
                               f"{freed} for the survivors",
                               file=sys.stderr)
+                    _blackbox_hint(pid, d)
                     if w.attempts >= max_restarts:
                         # out of budget: the worker LEAVES; this only
                         # fails the run if nobody is left to drain the
@@ -828,8 +850,11 @@ def serve_fleet_run(spool: str, n: int, serve_args: List[str],
                 if rc is None:
                     continue
                 name = "gateway" if w.rank < 0 else f"s{w.rank}"
+                pid = w.proc.pid
                 close_log(w)
                 w.proc = None
+                if rc not in (0, exitcodes.RC_INTERRUPTED):
+                    _blackbox_hint(pid, spool)
                 if rc in (0, exitcodes.RC_INTERRUPTED):
                     # clean exit or voluntary drain: the replica's
                     # leases are released, its queued work stays in

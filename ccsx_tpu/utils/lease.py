@@ -66,12 +66,16 @@ def read_lease(d: str, key: str) -> Optional[dict]:
 
 
 def try_acquire(d: str, key: str, worker: str,
-                extra: Optional[dict] = None) -> Optional[dict]:
+                extra: Optional[dict] = None,
+                kind: Optional[str] = None) -> Optional[dict]:
     """Acquire lease ``key``, or None if it is held.  ``O_CREAT|O_EXCL``
     is the arbitration: of any number of racers the kernel admits
     exactly one.  ``extra`` fields ride in the owner record (the fleet
-    plane stores the range index; the serve fleet stores replica name,
-    host and telemetry port)."""
+    plane stores the range index + correlation id; the serve fleet
+    stores replica name, host and telemetry port).  ``kind`` labels the
+    acquire-latency histogram family (job/range/slot); None skips the
+    observation (discovery-side callers)."""
+    t0 = time.monotonic()
     try:
         fd = os.open(lease_path(d, key),
                      os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
@@ -87,6 +91,16 @@ def try_acquire(d: str, key: str, worker: str,
         os.fsync(fd)
     finally:
         os.close(fd)
+    if kind:
+        # lease-acquire latency (create + owner-record fsync): reported
+        # through the installed tracer's Metrics so this module stays
+        # dependency-light — no-op when no tracer/metrics is installed
+        from ccsx_tpu.utils import trace
+
+        tr = trace.current()
+        if tr is not None and tr.metrics is not None:
+            tr.metrics.observe("lease_acquire_s",
+                               time.monotonic() - t0, kind)
     return rec
 
 
@@ -152,6 +166,25 @@ def steal_lease(d: str, key: str, cur: dict, kill: bool = True,
         os.replace(lease_path(d, key), dst)
     except OSError:
         return None
+    # forensics link: if the evicted holder left a black-box ring
+    # (CCSX_BLACKBOX), stamp its path into the graveyard record so the
+    # post-mortem (`ccsx-tpu blackbox`) is one hop from the eviction.
+    # Best effort — a torn lease has no pid and links nothing.
+    pid = cur.get("pid") if cur else None
+    if pid:
+        from ccsx_tpu.utils import blackbox
+
+        for bb_dir in (os.environ.get(blackbox.ENV_DIR), d):
+            if not bb_dir:
+                continue
+            bb_path = blackbox.box_path(bb_dir, int(pid))
+            if os.path.exists(bb_path):
+                try:
+                    write_json_atomic(dst,
+                                      dict(cur, blackbox=bb_path))
+                except OSError:
+                    pass
+                break
     return cur
 
 

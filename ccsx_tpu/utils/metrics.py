@@ -51,6 +51,113 @@ def resource_gauges() -> dict:
     return {"peak_rss_bytes": int(peak), "device_buffer_bytes": int(dev)}
 
 
+# ---- latency histograms ----------------------------------------------------
+#
+# ONE fixed log-spaced bucket ladder for every latency family.  Fixed
+# (not per-family) so multi-source aggregation can merge by summing
+# per-`le` counts unconditionally — `ccsx-tpu top` and the gateway
+# merge replica histograms without negotiating bucket layouts, and a
+# replica restarted on a newer build still merges with its older
+# peers.  Spans ~5ms (a warm lease acquire) to 5min (a cold-compile
+# job wall); observations past the top land in +Inf only.
+HIST_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
+
+
+class Histogram:
+    """A fixed-bucket latency histogram (Prometheus-shaped: cumulative
+    `le` buckets + sum + count).  NOT thread-safe on its own — callers
+    go through Metrics.observe(), which serializes under _count_lock
+    (the same discipline as bump())."""
+
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self):
+        # one slot per bucket bound + the +Inf overflow slot; stored
+        # NON-cumulative (per-bucket increments) — the renderer
+        # accumulates, which keeps merge() a plain elementwise sum
+        self.counts = [0] * (len(HIST_BUCKETS) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        v = max(float(value), 0.0)
+        i = 0
+        for b in HIST_BUCKETS:
+            if v <= b:
+                break
+            i += 1
+        self.counts[i] += 1
+        self.sum += v
+        self.count += 1
+
+    def snapshot(self) -> dict:
+        return {"counts": list(self.counts),
+                "sum": round(self.sum, 6), "count": self.count}
+
+
+def merge_hist(snaps) -> dict:
+    """Merge histogram SNAPSHOTS by summing per-`le` counts — never by
+    averaging quantiles (quantiles do not compose; summed buckets do).
+    Tolerates torn/foreign dicts by skipping them."""
+    out = {"counts": [0] * (len(HIST_BUCKETS) + 1), "sum": 0.0,
+           "count": 0}
+    for s in snaps:
+        try:
+            counts = s["counts"]
+            if len(counts) != len(out["counts"]):
+                continue
+            for i, c in enumerate(counts):
+                out["counts"][i] += int(c)
+            out["sum"] += float(s["sum"])
+            out["count"] += int(s["count"])
+        except (KeyError, TypeError, ValueError):
+            continue
+    out["sum"] = round(out["sum"], 6)
+    return out
+
+
+def hist_quantile(snap: dict, q: float):
+    """Estimate the q-quantile from a histogram snapshot the way
+    Prometheus' histogram_quantile does: find the bucket where the
+    cumulative count crosses q*count and interpolate linearly inside
+    it.  None when empty."""
+    try:
+        total = int(snap["count"])
+        counts = snap["counts"]
+    except (KeyError, TypeError, ValueError):
+        return None
+    if total <= 0:
+        return None
+    target = q * total
+    cum = 0
+    lo = 0.0
+    for i, b in enumerate(HIST_BUCKETS):
+        prev = cum
+        cum += counts[i]
+        if cum >= target:
+            frac = ((target - prev) / counts[i]) if counts[i] else 0.0
+            return round(lo + (b - lo) * frac, 6)
+        lo = b
+    # target lands in +Inf: the top bound is the honest answer
+    return float(HIST_BUCKETS[-1])
+
+
+def size_class(holes_total) -> str:
+    """The per-size-class label for job latency families: queue-wait
+    and wall distributions are only comparable within a size band (a
+    large job legitimately waits and runs longer).  Bands are in RAW
+    input holes; unknown totals get their own class rather than
+    polluting a band."""
+    if not holes_total:
+        return "unknown"
+    if holes_total <= 16:
+        return "small"
+    if holes_total <= 256:
+        return "medium"
+    return "large"
+
+
 class FailureBudgetExceeded(RuntimeError):
     """Raised by check_failure_budget when --max-failed-holes is
     exceeded: the run aborts with RC_FAILED_HOLES (exitcodes.py)
@@ -116,6 +223,12 @@ class Metrics:
     # ccsx_job_*{job="..."} series are attributable without relying on
     # file paths.
     job: Optional[str] = None
+    # fleet-wide correlation id (ISSUE 18): minted at job submission
+    # (gateway.submit_job / serve solo submit) and propagated through
+    # replica leases, fan-out range leases, and every span/metrics
+    # event — the key `ccsx-tpu report --fleet` stitches per-process
+    # JSONL files by.  None outside the serving plane.
+    cid: Optional[str] = None
     holes_in: int = 0
     holes_out: int = 0
     holes_failed: int = 0
@@ -278,6 +391,11 @@ class Metrics:
     fleet_ranks_alive: int = 0
     fleet_steals: int = 0
     fleet_rebalances: int = 0
+    # latency histograms (ISSUE 18): family name -> label value ->
+    # Histogram.  Families and their label keys are enumerated in
+    # telemetry.HIST_FAMILIES (schema-guarded both directions); all
+    # share the ONE fixed HIST_BUCKETS ladder so merges sum per-`le`.
+    hists: dict = dataclasses.field(default_factory=dict)
     # a "progress" JSONL event is emitted every progress_every retired
     # holes (0 disables); "final" is always emitted at report().  The
     # live-telemetry plane also emits one every progress_interval_s
@@ -333,7 +451,71 @@ class Metrics:
         """Atomically add deltas to counter fields (thread-safe +=)."""
         with self._count_lock:
             for k, v in deltas.items():
-                setattr(self, k, getattr(self, k) + v)
+                prev = getattr(self, k)
+                setattr(self, k, prev + v)
+                # time-to-first-dispatch: the 0 -> nonzero crossing of
+                # device_dispatches is the first device work this run
+                # issued — observed here (the one choke point every
+                # dispatch site already funnels through) so no driver
+                # needs its own first-dispatch bookkeeping
+                if (k == "device_dispatches" and prev == 0
+                        and getattr(self, k) > 0):
+                    self._observe_locked(
+                        "first_dispatch_s", time.monotonic() - self.t0,
+                        size_class(self.holes_total))
+
+    def _observe_locked(self, name: str, value: float,
+                        label: str = "") -> None:
+        """observe() body; caller holds _count_lock."""
+        fam = self.hists.setdefault(name, {})
+        h = fam.get(label)
+        if h is None:
+            h = fam[label] = Histogram()
+        h.observe(value)
+
+    def observe(self, name: str, value: float, label: str = "") -> None:
+        """Record one latency observation into a histogram family
+        (thread-safe; dispatch closures and lease acquires run on
+        executor/pump threads)."""
+        with self._count_lock:
+            self._observe_locked(name, value, label)
+
+    def hist_snapshot(self) -> dict:
+        """family -> label -> {counts, sum, count}, copied under the
+        lock (scraper threads race live observes)."""
+        with self._count_lock:
+            return {name: {lbl: h.snapshot() for lbl, h in fam.items()}
+                    for name, fam in self.hists.items()}
+
+    def merge_hists(self, hist: dict) -> None:
+        """Absorb another Metrics' hist snapshot — summing per-`le`
+        counts, the only legal histogram merge.  This is how serve
+        folds each finished job's fault-domain observations (first
+        dispatch, per-job families) into the server-lifetime snapshot
+        its /progress and /metrics expose."""
+        if not hist:
+            return
+        with self._count_lock:
+            for name, fam in hist.items():
+                if not isinstance(fam, dict):
+                    continue
+                for label, s in fam.items():
+                    try:
+                        counts = s["counts"]
+                        add_sum = float(s["sum"])
+                        add_count = int(s["count"])
+                    except (KeyError, TypeError, ValueError):
+                        continue
+                    dst = self.hists.setdefault(name, {})
+                    h = dst.get(label)
+                    if h is None:
+                        h = dst[label] = Histogram()
+                    if len(counts) != len(h.counts):
+                        continue
+                    for i, c in enumerate(counts):
+                        h.counts[i] += int(c)
+                    h.sum += add_sum
+                    h.count += add_count
 
     def bump_banded(self, impl: str, n: int = 1) -> None:
         """Attribute n banded DP-fill dispatches to an implementation
@@ -558,8 +740,12 @@ class Metrics:
                        for st in dict(self.group_stats).values())
             snap["compile_s"] = round(comp, 4)
             snap["compile_share"] = round(comp / self.elapsed, 4)
+        if self.hists:
+            snap["hist"] = self.hist_snapshot()
         if self.job:
             snap["job"] = self.job
+        if self.cid:
+            snap["cid"] = self.cid
         if self.degraded:
             snap["degraded"] = self.degraded
         # degraded-relevant detail: a FAILED native .so auto-rebuild

@@ -158,6 +158,75 @@ def collect(paths: List[str]) -> dict:
             "final": final, "last_metrics": last_metrics}
 
 
+def collect_fleet(d: str) -> dict:
+    """Stitch a FLEET's per-process JSONL files (a spool/fleet dir and
+    its immediate subdirs — fan-out dirs, worker trace files) into
+    per-JOB span sets keyed by correlation id.
+
+    Per-process monotonic clocks do not compose, so cross-process
+    alignment uses the WALL timestamp every span record carries
+    (``ts``, stamped at span open); within one fleet the boxes are
+    NTP-close and the render granularity is milliseconds.  Spans
+    without a ``cid`` belong to no job (server warmup, idle scans) and
+    are left out of the per-job timelines."""
+    import glob as globmod
+
+    paths = sorted(set(
+        globmod.glob(os.path.join(d, "*.jsonl"))
+        + globmod.glob(os.path.join(d, "*", "*.jsonl"))))
+    jobs: dict = {}
+    for path in paths:
+        src = os.path.basename(path)
+        if src.endswith(".jsonl"):
+            src = src[:-len(".jsonl")]
+        try:
+            f = open(path, encoding="utf-8")
+        except OSError:
+            continue
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                cid = rec.get("cid")
+                if (rec.get("ev") != "span" or not cid
+                        or rec.get("ts") is None):
+                    continue
+                args = rec.get("args", {})
+                # parse BEFORE creating the job entry: a cid whose
+                # every record is malformed/torn must not leave an
+                # empty-span job that crashes the alignment below
+                try:
+                    span = {
+                        "name": rec["name"],
+                        "cat": rec.get("cat", "host"),
+                        "ts": float(rec["ts"]),
+                        "dur": float(rec["dur"]),
+                        "tid": f"{src}:{rec.get('tid', 'main')}",
+                        "compile": bool(rec.get("compile")),
+                        "warmup": bool(rec.get("warmup")),
+                        "group": args.get("group")}
+                except (KeyError, TypeError, ValueError):
+                    continue
+                j = jobs.setdefault(cid, {"spans": [],
+                                          "sources": set()})
+                j["spans"].append(span)
+                j["sources"].add(src)
+    for j in jobs.values():
+        spans = j["spans"]
+        t0 = min(s["ts"] for s in spans)
+        for s in spans:
+            s["mono"] = s["ts"] - t0   # job-relative wall offset
+        spans.sort(key=lambda s: s["mono"])
+        j["t0"] = t0
+        j["t_end"] = max(s["mono"] + s["dur"] for s in spans)
+    return {"paths": paths, "jobs": jobs}
+
+
 # ---- SVG helpers ----------------------------------------------------------
 
 def _esc(v) -> str:
@@ -468,6 +537,54 @@ def render_html(paths: List[str], title: Optional[str] = None) -> str:
 """
 
 
+def render_fleet_html(d: str, title: Optional[str] = None) -> str:
+    """`report --fleet`: one page, ONE merged timeline per job —
+    every process that touched the job (holder replica, helper
+    replicas, fan-out workers) interleaved on wall-aligned lanes,
+    stitched by the correlation id the gateway minted at submission."""
+    data = collect_fleet(d)
+    jobs = data["jobs"]
+    name = os.path.basename(os.path.normpath(d)) or d
+    title = title or f"ccsx-tpu fleet report — {name}"
+    legend = "<div class='legend'>" + "".join(
+        f"<span><span class='sw c-{c}'></span>{c}</span>"
+        for c in CAT_ORDER) + "</div>"
+    sections = []
+    for cid in sorted(jobs, key=lambda c: jobs[c]["t0"]):
+        j = jobs[cid]
+        n = len(j["spans"])
+        spans = j["spans"]
+        if n > MAX_TIMELINE:
+            spans = sorted(spans, key=lambda s: s["dur"],
+                           reverse=True)[:MAX_TIMELINE]
+            spans.sort(key=lambda s: s["mono"])
+        srcs = ", ".join(sorted(j["sources"]))
+        sections.append(
+            f"<section><h2>Job <span class='mono'>{_esc(cid)}</span>"
+            f"</h2><p class='muted'>{n} spans across "
+            f"{len(j['sources'])} source(s): {_esc(srcs)}</p>"
+            f"{legend}{_timeline_svg(spans, j['t_end'], n)}</section>")
+    if not sections:
+        sections = [
+            "<section><p class='muted'>no correlated spans found — "
+            "fleet timelines need per-process --trace JSONL carrying "
+            "correlation ids (jobs submitted through the gateway or "
+            "serve API)</p></section>"]
+    css = _CSS_TMPL.replace("%CATS%", _cat_css())
+    return f"""<!DOCTYPE html>
+<html lang="en"><head><meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>{_esc(title)}</title>
+<style>{css}</style></head><body>
+<h1>{_esc(title)}</h1>
+<p class='muted'>fleet dir: {_esc(d)} &middot;
+{len(data['paths'])} JSONL file(s) &middot; {len(jobs)} correlated
+job(s) &middot; generated by `ccsx-tpu report --fleet`</p>
+{"".join(sections)}
+</body></html>
+"""
+
+
 def default_out_path(first_input: str) -> str:
     base = (first_input[:-6] if first_input.endswith(".jsonl")
             else first_input)
@@ -485,13 +602,37 @@ def report_main(argv) -> int:
                     "timeline strip, group compile/execute table, "
                     "stage breakdown, occupancy tiles, stall/recovery "
                     "log, ETA-vs-actual curve.")
-    ap.add_argument("paths", nargs="+",
+    ap.add_argument("paths", nargs="*",
                     help="trace and/or metrics JSONL files")
+    ap.add_argument("--fleet", default=None, metavar="DIR",
+                    help="stitch a fleet/spool directory's per-process "
+                         "JSONL into one merged per-job timeline page "
+                         "keyed by correlation id (ignores positional "
+                         "paths)")
     ap.add_argument("-o", "--out", default=None,
                     help="output HTML path "
-                         "[<first input minus .jsonl>.report.html]")
+                         "[<first input minus .jsonl>.report.html, or "
+                         "<fleet dir>/fleet.report.html]")
     ap.add_argument("--title", default=None)
     a = ap.parse_args(argv)
+    if not a.fleet and not a.paths:
+        ap.error("need JSONL paths or --fleet DIR")
+    if a.fleet:
+        out = a.out or os.path.join(a.fleet, "fleet.report.html")
+        try:
+            page = render_fleet_html(a.fleet, title=a.title)
+        except OSError as e:
+            print(f"Error: report: {e}", file=sys.stderr)
+            return 1
+        try:
+            with open(out, "w", encoding="utf-8") as f:
+                f.write(page)
+        except OSError as e:
+            print(f"Error: report: cannot write {out!r}: {e}",
+                  file=sys.stderr)
+            return 1
+        print(f"[ccsx-tpu] report: {out}", file=sys.stderr)
+        return 0
     out = a.out or default_out_path(a.paths[0])
     try:
         page = render_html(a.paths, title=a.title)

@@ -49,7 +49,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
-from ccsx_tpu.utils.metrics import Metrics, resource_gauges
+from ccsx_tpu.utils.metrics import (HIST_BUCKETS, Metrics, hist_quantile,
+                                    merge_hist, resource_gauges)
 
 # upward probes for a taken port: rank offsets + parallel runs on one
 # host land on distinct ports without operator bookkeeping
@@ -99,7 +100,42 @@ PROM_STRUCTURED = ("groups", "groups_forced", "degraded", "progress",
                    "breaker_state", "breaker_strike_log",
                    # failed native .so auto-rebuild (string detail;
                    # rendered as a 0/1 gauge like degraded)
-                   "native_build_error")
+                   "native_build_error",
+                   # multi-tenant/fleet identity labels (serve plane):
+                   # the job id and the fleet-wide correlation id ride
+                   # snapshots as strings, never as scalar samples
+                   "job", "cid",
+                   # latency histograms (HIST_FAMILIES below renders
+                   # them as _bucket/_sum/_count families)
+                   "hist")
+
+# latency-histogram families (ISSUE 18): (snapshot family name, label
+# key, Prometheus family name).  The snapshot side lives under
+# snap["hist"][<family>][<label>] (Metrics.observe); the exposition
+# side renders cumulative `le` buckets + +Inf + _sum/_count per label.
+# Schema-guarded BOTH directions (tests/test_telemetry.py): a family
+# renamed in Metrics cannot silently vanish from /metrics, and a new
+# snapshot family cannot ship unrendered.
+HIST_FAMILIES = (
+    ("queue_wait_s", "size", "queue_wait_seconds"),
+    ("job_wall_s", "size", "job_wall_seconds"),
+    ("first_dispatch_s", "size", "first_dispatch_seconds"),
+    ("device_execute_s", "group", "device_execute_seconds"),
+    ("lease_acquire_s", "kind", "lease_acquire_seconds"),
+)
+
+# derived SLO burn gauges: (gauge name, histogram family, threshold
+# seconds — MUST be one of metrics.HIST_BUCKETS so the "fraction over
+# threshold" is exact, not interpolated — and the objective).  burn =
+# (fraction of observations over threshold) / (1 - objective): 1.0
+# means the error budget is being spent exactly at the sustainable
+# rate, >1 means the SLO is burning down.  Served from every /metrics
+# that renders histograms, most usefully the gateway's fleet-merged
+# view (alongside the ccsx_fleet_* autoscale set).
+SLO_BURN_GAUGES = (
+    ("slo_queue_wait_burn", "queue_wait_s", 1.0, 0.95),
+    ("slo_job_wall_burn", "job_wall_s", 60.0, 0.99),
+)
 # per-group table fields exported as ccsx_group_<field>{group="..."}
 GROUP_FIELDS = ("compiles", "compile_s", "execute_s", "dispatches",
                 "dp_cells", "dp_cells_per_sec")
@@ -158,6 +194,71 @@ def _num(v):
     if v is None or isinstance(v, bool) or not isinstance(v, (int, float)):
         return None
     return v
+
+
+def _fmt_le(b: float) -> str:
+    return format(b, "g")
+
+
+def hist_lines(hist: dict) -> List[str]:
+    """Render snap["hist"] (family -> label -> {counts, sum, count})
+    into well-formed Prometheus histogram families: ONE TYPE line per
+    family, cumulative `le` buckets ending in +Inf, and _sum/_count per
+    label — the exposition shape promtool and histogram_quantile()
+    expect.  Families are emitted in HIST_FAMILIES order; snapshot
+    families outside the contract are skipped (the schema guard keeps
+    that set empty)."""
+    lines: List[str] = []
+    for fam, label_key, prom in HIST_FAMILIES:
+        series = (hist or {}).get(fam)
+        if not series:
+            continue
+        lines.append(f"# TYPE ccsx_{prom} histogram")
+        for label, h in sorted(series.items()):
+            counts = h.get("counts") or []
+            if len(counts) != len(HIST_BUCKETS) + 1:
+                continue
+            base = (f'{label_key}="{_prom_escape(label)}",'
+                    if label else "")
+            cum = 0
+            for i, b in enumerate(HIST_BUCKETS):
+                cum += int(counts[i])
+                lines.append(f'ccsx_{prom}_bucket{{{base}le="{_fmt_le(b)}"}}'
+                             f" {cum}")
+            cum += int(counts[-1])
+            lines.append(f'ccsx_{prom}_bucket{{{base}le="+Inf"}} {cum}')
+            lab = f'{{{base[:-1]}}}' if label else ""
+            lines.append(f"ccsx_{prom}_sum{lab} {h.get('sum', 0)}")
+            lines.append(f"ccsx_{prom}_count{lab} {cum}")
+    return lines
+
+
+def merged_family(hist: dict, fam: str) -> dict:
+    """One family's label series merged into a single histogram
+    snapshot (summing per-`le` counts — the only legal merge)."""
+    return merge_hist(list((hist or {}).get(fam, {}).values()))
+
+
+def slo_burn_lines(hist: dict) -> List[str]:
+    """The derived SLO burn gauges over a (possibly fleet-merged)
+    histogram snapshot.  A family with no observations emits nothing —
+    an idle fleet has no burn, not burn 0 vs NaN ambiguity."""
+    lines: List[str] = []
+    for gauge, fam, threshold, objective in SLO_BURN_GAUGES:
+        m = merged_family(hist, fam)
+        total = m["count"]
+        if not total:
+            continue
+        cum = 0
+        for i, b in enumerate(HIST_BUCKETS):
+            cum += m["counts"][i]
+            if b >= threshold:
+                break
+        frac_over = (total - cum) / total
+        burn = frac_over / (1.0 - objective)
+        lines.append(f"# TYPE ccsx_{gauge} gauge")
+        lines.append(f"ccsx_{gauge} {round(burn, 6)}")
+    return lines
 
 
 def render_prometheus(snap: dict, gauges: Optional[dict] = None) -> str:
@@ -219,6 +320,10 @@ def render_prometheus(snap: dict, gauges: Optional[dict] = None) -> str:
                labels=f'{{state="{_prom_escape(state)}"}}')
     for key, v in sorted((gauges or {}).items()):
         sample(key, v, "gauge")
+    hist = snap.get("hist")
+    if hist:
+        lines.extend(hist_lines(hist))
+        lines.extend(slo_burn_lines(hist))
     return "\n".join(lines) + "\n"
 
 
@@ -538,6 +643,27 @@ def aggregate(sources: List[dict]) -> dict:
     finished = [s for s in live
                 if str(s.get("status", "")).startswith("finished")]
     agg["finished"] = bool(sources) and len(finished) == len(sources)
+    # latency histograms: merge per-(family, label) by SUMMING per-`le`
+    # bucket counts — never by averaging per-source quantiles, which do
+    # not compose (two sources at p95=1s can have a fleet p95 of 10s)
+    hists = [s["snap"].get("hist") or {} for s in live]
+    merged: dict = {}
+    for fam, _label_key, _prom in HIST_FAMILIES:
+        labels = set()
+        for h in hists:
+            labels.update(h.get(fam) or {})
+        if labels:
+            merged[fam] = {
+                lbl: merge_hist([(h.get(fam) or {}).get(lbl)
+                                 for h in hists
+                                 if (h.get(fam) or {}).get(lbl)])
+                for lbl in sorted(labels)}
+    agg["hist"] = merged
+    for fam, key in (("queue_wait_s", "queue_wait"),
+                     ("job_wall_s", "job_wall")):
+        m = merged_family(merged, fam)
+        agg[f"{key}_p50"] = hist_quantile(m, 0.5)
+        agg[f"{key}_p95"] = hist_quantile(m, 0.95)
     return agg
 
 
@@ -556,6 +682,22 @@ def _fmt_eta(s) -> str:
     if s >= 60:
         return f"{s // 60}m{s % 60:02d}s"
     return f"{s}s"
+
+
+def _fmt_q(v) -> str:
+    """Compact quantile seconds for the top table ('-' when absent)."""
+    if v is None:
+        return "-"
+    return f"{v:.2f}" if v < 10 else f"{v:.0f}"
+
+
+def _source_quantiles(snap: dict, fam: str):
+    """(p50, p95) of one source's family, labels merged (None, None
+    when the source has no observations — plain runs, gateways)."""
+    m = merged_family(snap.get("hist") or {}, fam)
+    if not m["count"]:
+        return None, None
+    return hist_quantile(m, 0.5), hist_quantile(m, 0.95)
 
 
 def _bar(pct, width: int = 24) -> str:
@@ -612,8 +754,17 @@ def render_top(sources: List[dict], agg: dict, color: bool = True) -> str:
                        f"holes_failed {agg['holes_failed']}  "
                        f"device_hangs {agg['device_hangs']}  "
                        f"breaker_trips {agg['breaker_trips']}"))
+    if (agg.get("queue_wait_p50") is not None
+            or agg.get("job_wall_p50") is not None):
+        # fleet latency headline: quantiles of the SUMMED-bucket merge
+        lines.append(
+            f"  latency: queue-wait p50 {_fmt_q(agg['queue_wait_p50'])}s"
+            f" p95 {_fmt_q(agg['queue_wait_p95'])}s   "
+            f"job-wall p50 {_fmt_q(agg['job_wall_p50'])}s"
+            f" p95 {_fmt_q(agg['job_wall_p95'])}s")
     lines.append(c(_DIM, f"  {'source':<32} {'status':<18} "
-                         f"{'out':>8} {'rate':>8} {'pct':>6}"))
+                         f"{'out':>8} {'rate':>8} {'pct':>6} "
+                         f"{'qw50/95':>11} {'wall50/95':>11}"))
     for s in sources:
         snap = s.get("snap") or {}
         prog = snap.get("progress") or {}
@@ -625,11 +776,15 @@ def render_top(sources: List[dict], agg: dict, color: bool = True) -> str:
         else:
             status_c = f"{status:<18}"
         pct = prog.get("pct")
+        qw = _source_quantiles(snap, "queue_wait_s")
+        jw = _source_quantiles(snap, "job_wall_s")
         lines.append(
             f"  {s['source']:<32} {status_c} "
             f"{snap.get('holes_out', '-'):>8} "
             f"{prog.get('rate_zmws_per_sec', '-'):>8} "
-            f"{pct if pct is not None else '-':>6}")
+            f"{pct if pct is not None else '-':>6} "
+            f"{_fmt_q(qw[0]) + '/' + _fmt_q(qw[1]):>11} "
+            f"{_fmt_q(jw[0]) + '/' + _fmt_q(jw[1]):>11}")
         if snap.get("degraded"):
             lines.append(c(_RED, f"      {snap['degraded']}"))
         if s.get("error"):

@@ -65,6 +65,7 @@ no-op (cheaply) when nothing is installed.
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import heapq
 import json
 import os
@@ -73,6 +74,8 @@ import threading
 import time
 import traceback
 from typing import Dict, Optional
+
+from ccsx_tpu.utils import blackbox
 
 # span taxonomy (ARCHITECTURE.md "Observability"): every span carries
 # one of these categories, which the stats stage-breakdown sums over
@@ -97,6 +100,51 @@ RESILIENCE_KEYS = ("device_hangs", "breaker_state", "breaker_trips",
                    "stalls")
 
 _current: Optional["Tracer"] = None
+
+# ---- correlation ids (ISSUE 18) --------------------------------------------
+#
+# The fleet-wide correlation id: minted once at job submission
+# (gateway.submit_job / serve's solo submit) and entered here by
+# whichever thread is currently working that job (serve's per-job
+# thread, a fleet range worker, a helper pulling a sibling's range).
+# Scope is a ContextVar, NOT a process global: serve runs jobs
+# CONCURRENTLY (--max-active), so a process-wide cid would stamp one
+# job's spans with another's id and unbalanced scope exits would leak
+# a finished job's cid onto everything after it.  The job's device
+# work fans across executor/prep/pump threads, which plain
+# threading.Thread starts in a fresh context — those spawns go through
+# ``faultinject.inherit()`` (the prep pool and deadline runner
+# already do, for exactly this reason), which copies the spawning
+# context and therefore carries the cid.  Spans additionally CAPTURE
+# the cid at open, so a record written later from another thread (the
+# stall watchdog's dump) still names the right job.  Every trace
+# record and blackbox mirror written while a scope is open carries
+# {"cid": ...}.
+
+_cid_var: "contextvars.ContextVar[Optional[str]]" = \
+    contextvars.ContextVar("ccsx_cid", default=None)
+
+
+def current_cid() -> Optional[str]:
+    return _cid_var.get()
+
+
+@contextlib.contextmanager
+def cid_scope(cid: Optional[str]):
+    """Stamp ``cid`` on every trace/blackbox record emitted by this
+    context (and threads spawned through ``faultinject.inherit()``-
+    wrapped targets) for the duration of the with-block (None =
+    no-op: the ambient scope, if any, stays in force).  Token-based
+    restore: overlapping scopes on concurrent job threads cannot
+    clobber each other or leave a stale cid behind."""
+    if cid is None:
+        yield
+        return
+    token = _cid_var.set(cid)
+    try:
+        yield
+    finally:
+        _cid_var.reset(token)
 
 # the stall watchdog multiplies its timeout by this for the FIRST
 # device span of each (group, shape): first calls pay the XLA compile
@@ -151,7 +199,7 @@ def _null_ctx():
 
 class Span:
     __slots__ = ("tracer", "sid", "name", "cat", "args", "t0", "ts",
-                 "tid", "reported", "grace")
+                 "tid", "cid", "reported", "grace")
 
     def __init__(self, tracer, sid, name, cat, args):
         self.tracer = tracer
@@ -162,6 +210,10 @@ class Span:
         self.t0 = time.perf_counter()
         self.ts = time.time()
         self.tid = threading.current_thread().name
+        # captured at open: records derived from this span later, on
+        # OTHER threads (watchdog stall dumps), still name the right
+        # job even while concurrent jobs hold different ambient cids
+        self.cid = _cid_var.get()
         self.reported = False   # watchdog: this span already dumped
         self.grace = 1.0        # stall-timeout multiplier (COMPILE_GRACE
         #   for first-of-shape device spans; set by device_span)
@@ -223,7 +275,9 @@ class Tracer:
         self._tls = threading.local()
         self._f = open(self.path, "w", encoding="utf-8") \
             if self.path else None
-        if self._f is not None:
+        if self._f is not None or blackbox.get() is not None:
+            # the meta record also opens the blackbox ring's story for
+            # file-less tracers (serve's Tracer(None, ...))
             self._write({"ev": "meta", "pid": os.getpid(),
                          "ts": self._t0_wall,
                          "stall_timeout_s": self.stall_timeout})
@@ -237,6 +291,14 @@ class Tracer:
     # ---- record plumbing -------------------------------------------------
 
     def _write(self, rec: dict) -> None:
+        if "cid" not in rec:
+            cid = _cid_var.get()
+            if cid is not None:
+                rec["cid"] = cid
+        # mirror into the crash-persistent ring (no-op when
+        # CCSX_BLACKBOX is unset): the mmap'd copy is what survives a
+        # SIGKILL that the per-record flush below cannot outrun
+        blackbox.record(rec)
         f = self._f
         if f is None:
             return
@@ -269,6 +331,8 @@ class Tracer:
                "ts": round(sp.ts, 6),
                "mono": round(sp.t0 - self._t0, 6),
                "dur": round(dur, 6), "tid": sp.tid}
+        if sp.cid is not None:
+            rec["cid"] = sp.cid
         rec.update(extra)
         if sp.args:
             rec["args"] = sp.args
@@ -278,8 +342,9 @@ class Tracer:
 
     @contextlib.contextmanager
     def span(self, name: str, cat: str = "host", **args):
-        """A plain (non-device) span; records only when a file is open."""
-        if self._f is None:
+        """A plain (non-device) span; records only when a trace file is
+        open or the blackbox ring is armed (CCSX_BLACKBOX)."""
+        if self._f is None and blackbox.get() is None:
             yield _NULL_SPAN
             return
         sp = Span(self, -1, name, cat, args)
@@ -362,6 +427,19 @@ class Tracer:
                 self._grace_seen.add(gkey)
                 sp.grace = COMPILE_GRACE
             self._open[sid] = sp
+        # span-BEGIN mirror, ring only: a SIGKILL mid-dispatch never
+        # reaches the close record below, so the begin entry is the
+        # ONLY evidence of what was in flight — inflight() pairs it
+        # with the close by (tid, name)
+        bb = blackbox.get()
+        if bb is not None:
+            brec = {"ev": "begin", "name": name, "group": key,
+                    "ts": round(sp.ts, 6), "tid": sp.tid}
+            if shape is not None:
+                brec["shape"] = str(shape)
+            if sp.cid is not None:
+                brec["cid"] = sp.cid
+            bb.record(brec)
         pushed = self._f is not None
         if pushed:
             self._push()
@@ -378,6 +456,7 @@ class Tracer:
             # the accounting honest if one ever acquires children
             self_s = self._pop(dur) if pushed else dur
             first = False
+            executed = False
             with self._lock:
                 self._open.pop(sid, None)
                 if attribute and not failed:
@@ -407,6 +486,12 @@ class Tracer:
                         else:
                             st["execute_s"] += dur
                             st["exec_cells"] += int(cells or 0)
+                            executed = True
+            if executed and self.metrics is not None:
+                # per-group device-execute latency distribution
+                # (steady-state only: compile calls would put the XLA
+                # compile wall in the execute histogram)
+                self.metrics.observe("device_execute_s", dur, key)
             if failed or not attribute:
                 rec = self._span_rec(sp, dur)
             elif warmup:
@@ -419,7 +504,7 @@ class Tracer:
 
     def instant(self, name: str, cat: str = "host", **args) -> None:
         """A zero-duration marker (Chrome 'instant' event)."""
-        if self._f is None:
+        if self._f is None and blackbox.get() is None:
             return
         rec = {"ev": "instant", "name": name, "cat": cat,
                "ts": round(time.time(), 6),
@@ -497,6 +582,10 @@ class Tracer:
                "ts": round(time.time(), 6),
                "mono": round(time.perf_counter() - self._t0, 6),
                "tid": sp.tid, "args": sp.args}
+        if sp.cid is not None:
+            # the watchdog thread has no ambient scope: the stalled
+            # span's captured cid names the job that hung
+            rec["cid"] = sp.cid
         if full:
             rec["stacks"] = {k: v[-4000:] for k, v in stacks.items()}
         else:

@@ -1,0 +1,332 @@
+"""Crash-persistent black-box recorder (utils/blackbox.py) and the
+cross-process correlation id (ISSUE 18): ring write/recover WITHOUT a
+clean close, restart-resume and lap/seam behavior, in-flight pairing,
+env gating of the process singleton, the tracer mirror that stamps
+cids, the gateway's cid mint, and — slow tier — a real SIGKILL whose
+dump still names the in-flight work.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ccsx_tpu import cli
+from ccsx_tpu.pipeline import gateway
+from ccsx_tpu.utils import blackbox, synth, trace
+
+
+@pytest.fixture(autouse=True)
+def _isolated_singleton(monkeypatch):
+    """Each test starts with the plane OFF and a fresh singleton (get()
+    caches per pid; a leaked instance would write into another test's
+    tmp dir)."""
+    monkeypatch.delenv(blackbox.ENV_DIR, raising=False)
+    blackbox.reset()
+    yield
+    blackbox.reset()
+
+
+# ---- ring format -----------------------------------------------------------
+
+
+def test_ring_recovers_without_close(tmp_path):
+    """The crash-survival claim, minus the kill: records are readable
+    from the FILE with no close()/msync — file-backed mmap pages belong
+    to the kernel the moment they are written."""
+    p = str(tmp_path / "bb.bin")
+    box = blackbox.BlackBox(p, capacity=4096)
+    for i in range(5):
+        box.record({"i": i})
+    events = blackbox.read_dump(p)          # no close, no flush
+    assert [e["i"] for e in events] == list(range(5))
+    box.close()
+
+
+def test_restart_resumes_and_lap_drops_torn_oldest(tmp_path):
+    """A restarted pid resumes its old ring (head read back from the
+    header), and once the ring laps, the reader returns a contiguous
+    TAIL of the stream — the lap-seam record is torn and dropped, never
+    returned as garbage."""
+    p = str(tmp_path / "bb.bin")
+    box = blackbox.BlackBox(p, capacity=4096)
+    box.record({"n": 0})
+    box.close()
+    box = blackbox.BlackBox(p, capacity=4096)
+    assert box.head > 0                     # resumed, not zeroed
+    pad = "x" * 80
+    for n in range(1, 200):                 # ~100 B/record: laps 4 KiB
+        box.record({"n": n, "pad": pad})
+    box.close()
+    ns = [e["n"] for e in blackbox.read_dump(p)]
+    assert ns and ns == list(range(ns[0], 200))
+    assert 0 < ns[0] < 199                  # oldest lapped away, tail kept
+
+
+def test_read_dump_exactly_full_ring(tmp_path):
+    """head == capacity is the unwrapped boundary, not a lap: the ring
+    is exactly full of whole records and the reader must return them
+    all (a wrap-based slice at head % capacity == 0 returns nothing)."""
+    p = str(tmp_path / "bb.bin")
+    box = blackbox.BlackBox(p, capacity=4096)
+    pad = "x" * (4096 - 11)        # {"pad":"..."}\n == capacity bytes
+    box.record({"pad": pad})
+    assert box.head == box.capacity
+    events = blackbox.read_dump(p)
+    assert len(events) == 1 and events[0]["pad"] == pad
+    box.close()
+
+
+def test_reader_rejects_foreign_and_capacity_change_resets(tmp_path):
+    bad = tmp_path / "junk.bin"
+    bad.write_bytes(b"not a ring")
+    with pytest.raises(ValueError):
+        blackbox.read_dump(str(bad))
+    # a capacity change (CCSX_BLACKBOX_CAP bumped across a restart)
+    # starts the ring over instead of misreading old offsets
+    p = str(tmp_path / "bb.bin")
+    box = blackbox.BlackBox(p, capacity=4096)
+    box.record({"n": 1})
+    box.close()
+    box = blackbox.BlackBox(p, capacity=8192)
+    assert box.head == 0
+    box.close()
+
+
+def test_inflight_pairing():
+    """inflight() names exactly the UNFINISHED work: claim notes
+    without a 'done', and span-begin mirrors without their close."""
+    events = [
+        {"bb": "inflight", "what": "job", "id": "j1"},
+        {"bb": "inflight", "what": "range", "id": 3},
+        {"bb": "done", "what": "job", "id": "j1"},
+        {"ev": "begin", "tid": "T", "name": "refine"},
+        {"ev": "begin", "tid": "T", "name": "poa"},
+        {"ev": "span", "tid": "T", "name": "poa"},
+    ]
+    live = blackbox.inflight(events)
+    notes = {(e.get("what"), e.get("id")) for e in live if e.get("bb")}
+    assert notes == {("range", 3)}
+    assert [e["name"] for e in live if e.get("ev") == "begin"] == ["refine"]
+
+
+# ---- process singleton + env gating ----------------------------------------
+
+
+def test_env_gates_singleton(tmp_path, monkeypatch):
+    assert blackbox.get() is None           # plane off: no files, no cost
+    blackbox.note("inflight", what="job", id="j9")     # no-op
+    assert not list(tmp_path.iterdir())
+    monkeypatch.setenv(blackbox.ENV_DIR, str(tmp_path))
+    bb = blackbox.get()
+    assert bb is not None and blackbox.get() is bb     # cached per pid
+    blackbox.note("inflight", what="job", id="j9")
+    blackbox.reset()
+    events = blackbox.read_dump(blackbox.box_path(str(tmp_path)))
+    last = events[-1]
+    assert (last["bb"], last["what"], last["id"]) == ("inflight", "job", "j9")
+    assert last["pid"] == os.getpid() and last["ts"] > 0
+
+
+def test_unwritable_dir_disables_loudly(tmp_path, monkeypatch, capsys):
+    """An unusable CCSX_BLACKBOX must degrade the recorder (off, one
+    stderr line), never the run."""
+    f = tmp_path / "not_a_dir"
+    f.write_text("x")
+    monkeypatch.setenv(blackbox.ENV_DIR, str(f))
+    assert blackbox.get() is None
+    assert blackbox.ENV_DIR not in os.environ   # disabled for good
+    assert "blackbox disabled" in capsys.readouterr().err
+
+
+# ---- correlation id --------------------------------------------------------
+
+
+def test_cid_scope_stamps_trace_records_and_ring_mirror(tmp_path,
+                                                        monkeypatch):
+    """Every trace record written inside a cid_scope carries the cid —
+    in the JSONL file AND in the black-box mirror — and records outside
+    the scope stay unstamped (correlation is per job, not per
+    process-lifetime)."""
+    monkeypatch.setenv(blackbox.ENV_DIR, str(tmp_path))
+    tp = str(tmp_path / "t.jsonl")
+    tr = trace.Tracer(tp)
+    assert trace.current_cid() is None
+    with trace.cid_scope("cabc123def456"):
+        with tr.span("stitch"):
+            pass
+        tr.instant("mark")
+    with tr.span("outside"):
+        pass
+    tr.close()
+    recs = [json.loads(ln) for ln in open(tp) if ln.strip()]
+    by = {r["name"]: r for r in recs if "name" in r}
+    assert by["stitch"]["cid"] == "cabc123def456"
+    assert by["mark"]["cid"] == "cabc123def456"
+    assert "cid" not in by["outside"]
+    blackbox.reset()
+    ring = blackbox.read_dump(blackbox.box_path(str(tmp_path)))
+    assert any(r.get("ev") == "span" and r.get("name") == "stitch"
+               and r.get("cid") == "cabc123def456" for r in ring)
+
+
+def test_cid_scope_concurrent_jobs_do_not_cross(tmp_path):
+    """Serve runs jobs CONCURRENTLY (--max-active defaults to 2): two
+    overlapping scopes on different threads must each stamp their own
+    records, and the unbalanced exit interleave (A enters, B enters,
+    A exits, B exits) must not leave a finished job's cid on anything
+    written afterwards."""
+    import threading
+
+    tp = str(tmp_path / "t.jsonl")
+    tr = trace.Tracer(tp)
+    a_in, b_in, a_out = (threading.Event() for _ in range(3))
+
+    def job_a():
+        with trace.cid_scope("cjob-a"):
+            a_in.set()
+            b_in.wait(5)               # B's scope is now open too
+            with tr.span("work-a"):
+                pass
+        a_out.set()                    # A exited while B is still open
+
+    def job_b():
+        a_in.wait(5)
+        with trace.cid_scope("cjob-b"):
+            b_in.set()
+            a_out.wait(5)
+            with tr.span("work-b"):
+                pass
+
+    ta = threading.Thread(target=job_a)
+    tb = threading.Thread(target=job_b)
+    ta.start(); tb.start(); ta.join(5); tb.join(5)
+    assert trace.current_cid() is None
+    with tr.span("after"):
+        pass
+    tr.close()
+    by = {r["name"]: r for r in
+          (json.loads(ln) for ln in open(tp) if ln.strip())
+          if "name" in r}
+    assert by["work-a"]["cid"] == "cjob-a"
+    assert by["work-b"]["cid"] == "cjob-b"      # B survived A's exit
+    assert "cid" not in by["after"]             # nothing leaked
+
+
+def test_cid_inherited_by_worker_threads(tmp_path):
+    """A job's device work fans across pool threads spawned through
+    faultinject.inherit() (prep pool, deadline runner): the copied
+    context carries the cid, so worker-thread spans still name the
+    job."""
+    import threading
+
+    from ccsx_tpu.utils import faultinject
+
+    tp = str(tmp_path / "t.jsonl")
+    tr = trace.Tracer(tp)
+
+    def work():
+        with tr.span("pool-work"):
+            pass
+
+    with trace.cid_scope("cfam42"):
+        t = threading.Thread(target=faultinject.inherit(work))
+        t.start()
+        t.join(5)
+    tr.close()
+    recs = [json.loads(ln) for ln in open(tp) if ln.strip()]
+    sp = next(r for r in recs if r.get("name") == "pool-work")
+    assert sp["cid"] == "cfam42"
+
+
+def test_gateway_mints_cid_into_spool_record(tmp_path):
+    """submit_job mints the correlation id; the spool record carries it
+    (that is how the replica lease, fan-out leases, and fleet state
+    inherit it) and job_view exposes it to clients."""
+    spool = str(tmp_path / "spool")
+    jid = gateway.submit_job(spool, input_path="in.fa")
+    rec = gateway.read_job_record(spool, jid)
+    cid = rec["cid"]
+    assert cid.startswith("c") and len(cid) == 13
+    assert gateway.job_view(spool, jid)["cid"] == cid
+    # distinct submissions get distinct ids
+    jid2 = gateway.submit_job(spool, input_path="in2.fa")
+    assert gateway.read_job_record(spool, jid2)["cid"] != cid
+
+
+def test_output_bytes_identical_plane_on_off(tmp_path, rng, monkeypatch):
+    """The plane is observability, not semantics: a real CLI run with
+    the recorder armed emits byte-identical output to one without, and
+    the ring actually recorded the run's spans."""
+    zs = [synth.make_zmw(rng, template_len=700, n_passes=5, movie="mv",
+                         hole=str(h)) for h in range(2)]
+    fa = tmp_path / "in.fa"
+    fa.write_text(synth.make_fasta(zs))
+    out_off = str(tmp_path / "off.fa")
+    out_on = str(tmp_path / "on.fa")
+    assert cli.main(["-A", "-m", "1000", str(fa), out_off]) == 0
+    bb_dir = tmp_path / "bb"
+    monkeypatch.setenv(blackbox.ENV_DIR, str(bb_dir))
+    assert cli.main(["-A", "-m", "1000", str(fa), out_on]) == 0
+    blackbox.reset()
+    assert open(out_on, "rb").read() == open(out_off, "rb").read()
+    events = blackbox.read_dump(blackbox.box_path(str(bb_dir)))
+    assert any(e.get("ev") == "span" for e in events)
+
+
+# ---- the actual crash ------------------------------------------------------
+
+_CHILD = r"""
+import os, sys, time
+from ccsx_tpu.utils import blackbox, trace
+
+tr = trace.Tracer(None)              # file-less: the ring is the only sink
+with trace.cid_scope("cdeadbeef0001"):
+    blackbox.note("inflight", what="job", id="j7", cid="cdeadbeef0001")
+    with tr.device_span("refine_packed", group="packed:q9"):
+        print("READY", flush=True)
+        time.sleep(60)
+"""
+
+
+@pytest.mark.slow  # ~2s: subprocess spawn + interpreter import cost; the
+# in-process tier-1 siblings (test_ring_recovers_without_close,
+# test_cid_scope_stamps_trace_records_and_ring_mirror) pin the same
+# format/stamping guarantees without the kill
+def test_sigkill_leaves_readable_dump_naming_inflight_work(tmp_path):
+    """The acceptance crash: a replica SIGKILLed mid-dispatch (no
+    atexit, no flush) leaves a dump that names the in-flight job AND
+    the open device span, both stamped with the fleet cid."""
+    env = dict(os.environ, CCSX_BLACKBOX=str(tmp_path),
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen([sys.executable, "-c", _CHILD], env=env,
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        time.sleep(0.1)              # let the begin mirror land
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == -signal.SIGKILL
+    path = blackbox.box_path(str(tmp_path), proc.pid)
+    events = blackbox.read_dump(path)
+    live = blackbox.inflight(events)
+    jobs = [e for e in live if e.get("what") == "job"]
+    spans = [e for e in live if e.get("ev") == "begin"]
+    assert jobs and jobs[0]["id"] == "j7"
+    assert spans and spans[0]["name"] == "refine_packed"
+    assert spans[0]["group"] == "packed:q9"
+    assert {e.get("cid") for e in live} == {"cdeadbeef0001"}
+    # and the operator-facing renderer headlines it
+    import io
+
+    buf = io.StringIO()
+    assert blackbox.render(path, out=buf) == 0
+    page = buf.getvalue()
+    assert "in-flight at death" in page and "refine_packed" in page

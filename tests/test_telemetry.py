@@ -20,7 +20,8 @@ import pytest
 from ccsx_tpu import cli
 from ccsx_tpu.utils import faultinject, synth, telemetry, trace
 from ccsx_tpu.utils import report as report_mod
-from ccsx_tpu.utils.metrics import Metrics, resource_gauges
+from ccsx_tpu.utils.metrics import (HIST_BUCKETS, Metrics, hist_quantile,
+                                    merge_hist, resource_gauges, size_class)
 
 BENCH_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -211,6 +212,12 @@ def test_top_aggregates_two_rank_endpoints(capsys):
     degrades the whole."""
     m0 = _mk_metrics(60, total=100)
     m1 = _mk_metrics(30, total=100, degraded="stall watchdog fired: x")
+    # per-rank latency histograms: `top` must merge them by SUMMING
+    # per-`le` bucket counts (quantiles do not compose)
+    for v in (0.2, 0.2, 0.4):
+        m0.observe("queue_wait_s", v, "small")
+    for v in (0.9, 0.9, 0.9):
+        m1.observe("queue_wait_s", v, "small")
     s0 = telemetry.TelemetryServer(m0, _free_port(), host="127.0.0.1")
     s1 = telemetry.TelemetryServer(m1, _free_port(), host="127.0.0.1")
     try:
@@ -223,6 +230,13 @@ def test_top_aggregates_two_rank_endpoints(capsys):
         assert agg["total"] == 200
         assert agg["any_degraded"] is True
         assert srcs[1]["status"] == "degraded"
+        # summed buckets: 6 observations total, and the fleet p50 is
+        # computed from the MERGED distribution (0.5 — the bucket where
+        # the combined cumulative count crosses 3), not from averaging
+        # the two per-rank medians
+        merged = agg["hist"]["queue_wait_s"]["small"]
+        assert merged["count"] == 6
+        assert agg["queue_wait_p50"] == 0.5
         # the rendered frame carries the aggregate + the degraded mark
         rc = cli.main(["top", "--once", "--no-color",
                        f"127.0.0.1:{s0.port}", f"127.0.0.1:{s1.port}"])
@@ -231,6 +245,8 @@ def test_top_aggregates_two_rank_endpoints(capsys):
         assert "DEGRADED" in out
         assert "out 90" in out
         assert "stall watchdog fired: x" in out
+        assert "latency:" in out               # fleet quantile headline
+        assert "qw50/95" in out                # per-source columns
     finally:
         s0.close()
         s1.close()
@@ -327,6 +343,24 @@ def test_report_default_out_path():
             == "x/t.report.html")
 
 
+def test_collect_fleet_tolerates_torn_records(tmp_path):
+    """A cid whose every span record is malformed (a torn JSONL line
+    missing 'dur' — exactly what a killed replica leaves behind) must
+    be dropped, not crash the alignment with an empty span list; good
+    jobs in the same dir still stitch."""
+    d = tmp_path / "spool"
+    d.mkdir()
+    good = {"ev": "span", "name": "refine", "cat": "device",
+            "ts": 100.0, "dur": 0.5, "tid": "T", "cid": "cgood"}
+    torn = {"ev": "span", "name": "refine", "cat": "device",
+            "ts": 101.0, "tid": "T", "cid": "ctorn"}   # no 'dur'
+    (d / "a.jsonl").write_text(
+        json.dumps(good) + "\n" + json.dumps(torn) + "\n")
+    data = report_mod.collect_fleet(str(d))
+    assert set(data["jobs"]) == {"cgood"}
+    assert data["jobs"]["cgood"]["t_end"] == 0.5
+
+
 # ---- schema-drift guard ----------------------------------------------------
 
 
@@ -359,6 +393,15 @@ def _populated_snapshot():
     m.group_stats["g"] = {"compiles": 1, "compile_s": 0.1,
                           "execute_s": 0.2, "dispatches": 3,
                           "dp_cells": 40, "exec_cells": 30}
+    m.job = "j0007"
+    m.cid = "cfeedfacecafe"
+    # one observation into EVERY latency family, so the key-set guards
+    # and the exposition test cover the full histogram contract
+    m.observe("queue_wait_s", 0.3, "small")
+    m.observe("job_wall_s", 70.0, "large")
+    m.observe("first_dispatch_s", 0.1, "small")
+    m.observe("device_execute_s", 0.02, "g")
+    m.observe("lease_acquire_s", 0.001, "job")
     return m.snapshot()
 
 
@@ -422,6 +465,121 @@ def test_prometheus_render_wellformed():
     assert "ccsx_degraded 1" in text
     assert "ccsx_peak_rss_bytes" in text
     assert "ccsx_progress_pct" in text
+
+
+# ---- latency histograms + SLO burn gauges ----------------------------------
+
+
+def test_hist_schema_guard_both_directions():
+    """HIST_FAMILIES <-> snapshot, both ways: a family renamed in
+    Metrics cannot silently vanish from /metrics, and a new snapshot
+    family cannot ship unrendered.  The SLO gauges must also reference
+    real families and EXACT bucket bounds (the burn fraction is read
+    off a cumulative bucket, never interpolated)."""
+    snap = _populated_snapshot()
+    fams = {f for f, _, _ in telemetry.HIST_FAMILIES}
+    assert fams == set(snap["hist"]), (
+        "histogram families drifted between Metrics.observe call sites "
+        "and telemetry.HIST_FAMILIES")
+    for _gauge, fam, threshold, objective in telemetry.SLO_BURN_GAUGES:
+        assert fam in fams
+        assert threshold in HIST_BUCKETS
+        assert 0 < objective < 1
+
+
+def test_prometheus_histogram_exposition_wellformed():
+    """Every family renders the exposition shape promtool and
+    histogram_quantile() expect: cumulative nondecreasing `le` buckets
+    over the shared ladder, a +Inf bucket equal to _count, and _sum —
+    all under the family's declared label key."""
+    snap = _populated_snapshot()
+    text = telemetry.render_prometheus(snap, resource_gauges())
+    samples = {}
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        samples[name] = float(value)
+    labels = {"queue_wait_s": "small", "job_wall_s": "large",
+              "first_dispatch_s": "small", "device_execute_s": "g",
+              "lease_acquire_s": "job"}
+    for fam, label_key, prom in telemetry.HIST_FAMILIES:
+        assert f"# TYPE ccsx_{prom} histogram" in text, prom
+        base = f'{label_key}="{labels[fam]}"'
+        cum = [samples[f'ccsx_{prom}_bucket{{{base},le="{format(b, "g")}"}}']
+               for b in HIST_BUCKETS]
+        inf = samples[f'ccsx_{prom}_bucket{{{base},le="+Inf"}}']
+        cum.append(inf)
+        assert cum == sorted(cum), f"{prom}: buckets not cumulative"
+        assert inf == samples[f"ccsx_{prom}_count{{{base}}}"] == 1
+        assert f"ccsx_{prom}_sum{{{base}}}" in samples
+
+
+def test_slo_burn_gauge_math():
+    """burn = (fraction over threshold) / (1 - objective): 19 waits
+    under the 1s queue-wait threshold + 1 over, at a 95% objective, is
+    exactly burn 1.0 (spending the error budget at the sustainable
+    rate).  A family with NO observations emits nothing — an idle
+    fleet has no burn, not a fake 0."""
+    m = Metrics()
+    for _ in range(19):
+        m.observe("queue_wait_s", 0.5, "small")
+    m.observe("queue_wait_s", 70.0, "small")
+    text = "\n".join(telemetry.slo_burn_lines(m.hist_snapshot()))
+    assert "ccsx_slo_queue_wait_burn 1.0" in text
+    assert "slo_job_wall_burn" not in text
+    assert telemetry.slo_burn_lines({}) == []
+
+
+def test_hist_merge_and_quantile_math():
+    """merge_hist sums per-`le` counts elementwise; hist_quantile
+    interpolates inside the crossing bucket (Prometheus-style) and
+    answers the top bound for +Inf-landing targets."""
+    a, b = Metrics(), Metrics()
+    for v in (0.2, 0.2, 0.4):
+        a.observe("queue_wait_s", v, "small")
+    for v in (0.9, 0.9, 0.9):
+        b.observe("queue_wait_s", v, "small")
+    sa = a.hist_snapshot()["queue_wait_s"]["small"]
+    sb = b.hist_snapshot()["queue_wait_s"]["small"]
+    m = merge_hist([sa, sb])
+    assert m["count"] == 6
+    assert m["counts"] == [x + y for x, y in zip(sa["counts"],
+                                                 sb["counts"])]
+    assert hist_quantile(m, 0.5) == 0.5
+    # torn/foreign snapshots are skipped, not fatal
+    assert merge_hist([sa, None, {"counts": [1]}, "x"])["count"] == 3
+    assert hist_quantile({"counts": [], "count": 0}, 0.5) is None
+    # everything past the ladder top: the top bound is the honest p99
+    top = Metrics()
+    top.observe("job_wall_s", 9999.0, "large")
+    s = top.hist_snapshot()["job_wall_s"]["large"]
+    assert hist_quantile(s, 0.99) == HIST_BUCKETS[-1]
+
+
+def test_size_class_bands():
+    assert size_class(None) == "unknown"
+    assert size_class(0) == "unknown"
+    assert size_class(16) == "small"
+    assert size_class(17) == "medium"
+    assert size_class(256) == "medium"
+    assert size_class(257) == "large"
+
+
+def test_merge_hists_folds_job_snapshot_into_core():
+    """serve's _finish path: a finished job's hist snapshot folds into
+    the server-lifetime Metrics by summed buckets."""
+    core, job = Metrics(), Metrics()
+    core.observe("first_dispatch_s", 0.1, "small")
+    job.observe("first_dispatch_s", 0.2, "small")
+    job.observe("device_execute_s", 0.05, "g")
+    core.merge_hists(job.hist_snapshot())
+    snap = core.hist_snapshot()
+    assert snap["first_dispatch_s"]["small"]["count"] == 2
+    assert snap["device_execute_s"]["g"]["count"] == 1
+    core.merge_hists({"first_dispatch_s": {"small": {"bad": 1}},
+                      "junk": "x"})     # malformed entries are skipped
+    assert core.hist_snapshot()["first_dispatch_s"]["small"]["count"] == 2
 
 
 def test_port_range_clamped_at_65535():
