@@ -4,7 +4,21 @@
 PY ?= python
 PYTEST_FLAGS = -q -p no:cacheprovider -p no:xdist -p no:randomly
 
-.PHONY: chaos chaos-soak fleet-chaos serve-chaos serve-fleet-chaos fuzz fuzz-sweep tier1 tier1-shard native long-molecule pallas-ab
+.PHONY: chaos chaos-soak fleet-chaos serve-chaos serve-fleet-chaos fuzz fuzz-sweep tier1 tier1-shard native long-molecule pallas-ab lint
+
+# the static-analysis plane (ccsx_tpu/lint/): the repo-native checkers
+# over the tree against the committed baseline (lint_baseline.json),
+# then ruff with the pinned config in pyproject.toml when available
+# (the container doesn't ship it; the gate is the repo-native pass,
+# which tests/test_lint.py also runs as a tier-1 test).  Exit 0 iff
+# zero unsuppressed findings.
+lint:
+	$(PY) -m ccsx_tpu.cli lint
+	@if command -v ruff >/dev/null 2>&1; then \
+	  ruff check ccsx_tpu tests benchmarks; \
+	else \
+	  echo "ruff not installed; skipping (config pinned in pyproject.toml)"; \
+	fi
 
 # the long-template (ultra-long-read) A/B: prefilter + device seeding
 # vs the legacy host path, interleaved arms, bytes asserted identical

@@ -1,0 +1,133 @@
+"""Jax-free direct driver for the native layer's threaded paths.
+
+Exists because pytest under TSAN blows the time budget before collecting
+a single test: the jax import in tests/conftest.py runs 10-20x slower
+instrumented (R10_NOTES.md).  This script imports only numpy + the
+ccsx_tpu IO/native modules and drives every lock/condvar/atomic path the
+native layer has, so the sanitizer battery is:
+
+    make -C ccsx_tpu/native tsan
+    LD_PRELOAD=$(g++ -print-file-name=libtsan.so) \
+      TSAN_OPTIONS=exitcode=66 CCSX_BGZF_THREADS=4 \
+      python benchmarks/tsan_native_drive.py
+    make -C ccsx_tpu/native clean all   # ALWAYS restore (see R10_NOTES.md)
+
+(Also valid under ASAN with ASAN_OPTIONS=detect_leaks=0.)  Paths driven:
+
+- BGZF-MT block-parallel inflate (worker pool + prefetch producer thread
+  + consumer) over a 240-record BGZF BAM at CCSX_BGZF_THREADS=4;
+- the salvage resync path: two corrupt-payload BGZF blocks classified by
+  the PRODUCER while the consumer polls the atomic event counter;
+- the budget-exempt bgzf_missing_eof atomic (EOF marker stripped);
+- the plain (non-prefetch) native streamer as the single-thread oracle;
+- 500 records through the async ordered NativeFastaWriter (fwrite on a
+  C++ thread off the GIL);
+- encode/revcomp round-trips.
+
+rc 0 + "OK" line = clean; any TSAN warning fails via exitcode=66.
+"""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from ccsx_tpu.config import CcsConfig          # noqa: E402
+from ccsx_tpu.io import bam as bam_mod         # noqa: E402
+from ccsx_tpu.native import available, build_error  # noqa: E402
+from ccsx_tpu.native.io import (encode_native, revcomp_codes_native,  # noqa: E402
+                                stream_zmws_native, stream_zmws_prefetch,
+                                NativeFastaWriter)
+
+BGZF_MAGIC = b"\x1f\x8b\x08\x04"
+# static BGZF EOF marker (SAM spec 4.1.2): an empty member, 28 bytes
+BGZF_EOF = bytes.fromhex(
+    "1f8b08040000000000ff0600424302001b0003000000000000000000")
+
+
+def _mk_records(n=240, seqlen=1000):
+    rng = np.random.default_rng(20)
+    out = []
+    for i in range(n):
+        seq = rng.choice(list(b"ACGT"), seqlen).astype(np.uint8).tobytes()
+        # 6 subreads per hole clears the count filter (>= min_fulllen_count+2)
+        out.append((f"mv/{i // 6}/{i}_{i + seqlen}", seq, b"\x20" * seqlen))
+    return out
+
+
+def _corrupt_two_blocks(raw: bytes) -> bytes:
+    offs = []
+    p = raw.find(BGZF_MAGIC)
+    while p != -1:
+        offs.append(p)
+        p = raw.find(BGZF_MAGIC, p + 1)
+    assert len(offs) >= 4, f"need a multi-block BGZF, got {len(offs)} members"
+    buf = bytearray(raw)
+    for o in (offs[1], offs[len(offs) // 2]):
+        buf[o + 40] ^= 0xFF  # inside the deflate payload -> CRC mismatch
+    return bytes(buf)
+
+
+def main() -> int:
+    assert available(), f"native library unavailable: {build_error()}"
+    cfg = CcsConfig(min_subread_len=1, is_bam=True)
+    cfg_s = CcsConfig(min_subread_len=1, is_bam=True, salvage=True)
+    recs = _mk_records()
+    n_holes = len({r[0].split("/")[1] for r in recs})
+
+    with tempfile.TemporaryDirectory() as td:
+        clean = os.path.join(td, "clean.bam")
+        bam_mod.write_bam(clean, recs, bgzf=True)
+        raw = open(clean, "rb").read()
+
+        # 1) single-thread oracle, then the prefetch/pool stack on the
+        #    same clean file: same holes either way
+        plain = [z.hole for z in stream_zmws_native(clean, cfg)]
+        pool = [z.hole for z in stream_zmws_prefetch(clean, cfg)]
+        assert plain == pool and len(plain) == n_holes, (
+            len(plain), len(pool), n_holes)
+
+        # 2) salvage resync through the prefetch stack: producer
+        #    classifies the two bad blocks + books the atomic event
+        #    counter while the consumer polls it per yield
+        dirty = os.path.join(td, "dirty.bam")
+        with open(dirty, "wb") as f:
+            f.write(_corrupt_two_blocks(raw))
+        salvaged = [z.hole for z in stream_zmws_prefetch(dirty, cfg_s)]
+        assert 0 < len(salvaged) < n_holes + 1, len(salvaged)
+
+        # 3) the budget-exempt bgzf_missing_eof atomic: strip the EOF
+        #    marker, stream with salvage on
+        noeof = os.path.join(td, "noeof.bam")
+        assert raw.endswith(BGZF_EOF), "writer did not emit the EOF marker"
+        with open(noeof, "wb") as f:
+            f.write(raw[: -len(BGZF_EOF)])
+        ne = [z.hole for z in stream_zmws_prefetch(noeof, cfg_s)]
+        assert ne == plain, (len(ne), len(plain))
+
+        # 4) async ordered writer: 500 records, fwrite off the GIL
+        out = os.path.join(td, "w.fa")
+        w = NativeFastaWriter(out)
+        for i in range(500):
+            w.put(f"ccs/{i}", b"ACGTAC" * 50)
+        w.close()
+        assert open(out, "rb").read().count(b">") == 500
+
+        # 5) encode/revcomp round-trips
+        seq = b"ACGTNACGT" * 100
+        codes = encode_native(seq)
+        rc2 = revcomp_codes_native(revcomp_codes_native(codes))
+        assert np.array_equal(codes, rc2)
+
+    print(f"OK: {len(plain)} holes plain==prefetch, "
+          f"{len(salvaged)} salvaged past 2 corrupt blocks, "
+          f"missing-EOF stream intact, 500 async writes, "
+          f"encode/revcomp round-trip")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

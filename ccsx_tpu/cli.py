@@ -232,6 +232,19 @@ ccsx-tpu blackbox <path>.. (render crash-persistent flight-recorder
                            span at death, then the event tail.  A
                            directory argument expands to every ring
                            inside it; --tail N)
+ccsx-tpu lint [files...]  (repo-native static analysis, pure ast — no
+                           jax: int32-overflow hazards in ops/ traced
+                           code, bare writes in lease/journal/spool
+                           domains, off-lock Metrics mutation,
+                           ContextVar set without token restore,
+                           device spans closing unforced, and the
+                           static telemetry schema cross-check.
+                           Suppressions live in lint_baseline.json
+                           (committed, every entry justified) or
+                           inline `# lint: ok[check] reason`; --json
+                           for machine output, --gauge-file to
+                           publish the lint_findings dashboard gauge;
+                           exit 0 iff clean.  Also: make lint)
 """
 
 
@@ -691,6 +704,13 @@ def main(argv: Optional[list] = None) -> int:
         from ccsx_tpu.utils import blackbox
 
         return blackbox.blackbox_main(argv[1:])
+    if argv and argv[0] == "lint":
+        # repo-native static analysis (ccsx_tpu/lint/) — pure ast, no
+        # jax by contract: it gates tier-1 on the 1-core box in
+        # seconds (tests/test_lint.py asserts the no-jax discipline)
+        from ccsx_tpu.lint.core import lint_main
+
+        return lint_main(argv[1:])
     args = build_parser().parse_args(argv)
     if args.help:
         return usage()  # rc 1, like the reference (main.c:761)

@@ -1,0 +1,73 @@
+"""span-force: device spans must force execution before they close.
+
+jax dispatch is asynchronous — a ``device_span`` that wraps only the
+dispatch call times the enqueue, not the device: every kernel looks
+free and the compile/execute attribution table (the thing BENCH
+rounds and the promotion harness read) becomes fiction.  The r09
+methodology rule: the attributed path inside a device span must reach
+a ``jax.block_until_ready`` or ``Span.force`` (which calls it) before
+the span closes.
+
+Rule: a ``with ... device_span(...)`` block whose body contains
+neither a ``block_until_ready`` call nor a ``.force(...)`` call is
+flagged.  Lambdas and nested defs inside the body count (the deadline
+runner receives the forcing closure), which errs toward silence —
+the checker guards against the span that *cannot* force, not against
+conditional paths that sometimes don't.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Sequence
+
+from ccsx_tpu.lint.core import Finding
+
+CHECK = "span-force"
+
+MESSAGE = ("device_span closes without forcing execution — add "
+           "jax.block_until_ready(...) or sp.force(...) on the "
+           "attributed path, or the span times the async dispatch, "
+           "not the device")
+
+
+def _is_device_span_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    name = fn.id if isinstance(fn, ast.Name) else (
+        fn.attr if isinstance(fn, ast.Attribute) else None)
+    return name == "device_span"
+
+
+def _forces(body: Sequence[ast.stmt]) -> bool:
+    for stmt in body:
+        for sub in ast.walk(stmt):
+            if not isinstance(sub, ast.Call):
+                continue
+            fn = sub.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None)
+            if name in ("block_until_ready", "force"):
+                return True
+    return False
+
+
+def _line_text(lines: Sequence[str], lineno: int) -> str:
+    return lines[lineno - 1].strip() if 1 <= lineno <= len(lines) else ""
+
+
+def check(tree: ast.AST, src: str, lines: Sequence[str],
+          relpath: str) -> Iterable[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        if not any(_is_device_span_call(item.context_expr)
+                   for item in node.items):
+            continue
+        if _forces(node.body):
+            continue
+        out.append(Finding(CHECK, relpath, node.lineno, node.col_offset,
+                           MESSAGE, _line_text(lines, node.lineno)))
+    return out

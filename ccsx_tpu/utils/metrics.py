@@ -430,6 +430,12 @@ class Metrics:
     # detail an operator triages by
     degraded: Optional[str] = None
     stalls: int = 0
+    # unsuppressed ccsx-lint findings (ccsx_tpu/lint/): populated by a
+    # supervisor that runs `ccsx-tpu lint --gauge-file` (or bump()s it
+    # directly) so fleet dashboards watch static-analysis drift the
+    # same way they watch stalls; 0 = clean tree, never populated on
+    # the pipeline's own hot path
+    lint_findings: int = 0
     # set by the Tracer: True when device spans used the forced-
     # execution close (--trace), i.e. the group table's seconds are
     # real chip walls; False means dispatch-queue bookkeeping on an
@@ -746,6 +752,9 @@ class Metrics:
             snap["job"] = self.job
         if self.cid:
             snap["cid"] = self.cid
+        # always present (None when clean) so the schema guards see the
+        # key; the renderer drops None-valued samples
+        snap["lint_findings"] = self.lint_findings or None
         if self.degraded:
             snap["degraded"] = self.degraded
         # degraded-relevant detail: a FAILED native .so auto-rebuild

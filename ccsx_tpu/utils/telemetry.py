@@ -90,6 +90,10 @@ PROM_GAUGES = (
     # elastic fleet plane: live leased-range queue + fleet membership
     "fleet_ranges_total", "fleet_ranges_queued", "fleet_ranges_leased",
     "fleet_ranks_alive",
+    # static-analysis plane (ccsx_tpu/lint/): unsuppressed findings a
+    # supervisor published via `ccsx-tpu lint --gauge-file`; None
+    # (unpopulated) in runs that never lint
+    "lint_findings",
 )
 # snapshot keys with dedicated (non-scalar) renderings
 PROM_STRUCTURED = ("groups", "groups_forced", "degraded", "progress",

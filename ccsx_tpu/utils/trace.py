@@ -544,7 +544,9 @@ class Tracer:
         full = now - self._last_full_dump >= FULL_DUMP_EVERY_S
         self._stall_dumps += 1
         if self.metrics is not None:
-            self.metrics.stalls += 1
+            # the watchdog thread runs concurrently with driver/pool
+            # bump()s — take the counter lock like every other writer
+            self.metrics.bump(stalls=1)
         if full:
             self._last_full_dump = now
             names = {t.ident: t.name for t in threading.enumerate()}

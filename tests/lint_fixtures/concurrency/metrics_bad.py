@@ -1,0 +1,22 @@
+"""Known-bad twin for metrics-lock + contextvar-restore."""
+
+import contextvars
+
+_cid = contextvars.ContextVar("ccsx_cid", default=None)
+
+
+def ingest(metrics, n):
+    # racy read-modify-write: a prep-pool bump() between the read and
+    # the write silently loses counts
+    metrics.holes_in += n
+
+
+class Watchdog:
+    def fire(self):
+        self.metrics.stalls += 1
+
+
+def enter_job(cid):
+    # token dropped: the cid leaks into every later job on this
+    # thread (the r17 cross-stamp)
+    _cid.set(cid)

@@ -1,0 +1,23 @@
+"""Minimal-fix sibling: the same updates through the sanctioned
+patterns.  MUST produce no findings."""
+
+import contextvars
+
+_cid = contextvars.ContextVar("ccsx_cid", default=None)
+
+
+def ingest(metrics, n):
+    metrics.bump(holes_in=n)          # locked counter add
+    metrics.prep_queue_depth = n      # single-writer gauge publish
+
+
+def scope_arm(cid):
+    return _cid.set(cid)              # token handed to the caller
+
+
+def cid_scope(cid):
+    token = _cid.set(cid)
+    try:
+        return token
+    finally:
+        _cid.reset(token)             # the trace.cid_scope shape

@@ -1,0 +1,11 @@
+"""Known-bad twin for the bare-write checker: a lease-domain module
+writing state with bare open + json.dump, no atomic publish."""
+
+import json
+
+
+def renew_lease(path, obj):
+    # torn on SIGKILL between truncate and the last write: a reader
+    # (or the crash-recovery scan) sees half a lease record
+    with open(path, "w") as f:
+        json.dump(obj, f)

@@ -1,0 +1,9 @@
+"""Bad twin, marker-path variant: not a domain module by name, but
+the path expression names a spool artifact."""
+
+import os
+
+
+def publish(spool_dir, jid, body):
+    with open(os.path.join(spool_dir, f"job.{jid}.json"), "w") as f:
+        f.write(body)

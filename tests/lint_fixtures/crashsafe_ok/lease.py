@@ -1,0 +1,26 @@
+"""Minimal-fix sibling for the bare-write checker: the same writes
+through the crash-safe idioms.  MUST produce no findings."""
+
+import json
+import os
+
+
+def renew_lease(path, obj):
+    # stage + fsync + atomic replace (the write_json_atomic shape):
+    # the bare open is exempt because the SAME function publishes
+    # atomically
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def try_acquire(path, payload):
+    # O_EXCL acquire: creation IS the publish
+    fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+    try:
+        os.write(fd, payload)
+    finally:
+        os.close(fd)

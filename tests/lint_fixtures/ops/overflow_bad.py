@@ -1,0 +1,22 @@
+"""Known-bad twin for the int32-overflow checker: BOTH historical
+wrap expressions, verbatim.  Never imported — parsed only."""
+
+import jax.numpy as jnp
+
+
+def _line_interp_pre_r11(ip, span, denom):
+    # the r11 bug: ip*span exceeds 2**31 past ~47kb templates and the
+    # traced int32 product wraps silently, truncating the band
+    return ip * span // denom
+
+
+def compute_offsets_pre_r14(i, li0, lj0, li1, lj1):
+    # the r14 twin: compute_offsets re-derived the same interpolation
+    # instead of importing the fixed _line_interp
+    nom_j = lj0 + (i - li0) * (lj1 - lj0) // jnp.maximum(li1 - li0, 1)
+    return nom_j
+
+
+def pack_key(hole_id, bits):
+    # traced value shifted by a traced amount: same silent wrap
+    return hole_id << bits

@@ -1,0 +1,36 @@
+"""Minimal-fix sibling for the int32-overflow checker: the same
+arithmetic through the sanctioned idioms.  MUST produce no findings."""
+
+import jax.numpy as jnp
+
+LIMB_BITS = 8
+
+
+def _line_interp_limbed(ip, span, denom):
+    # the shipped fix (ops/banded._line_interp): slope + 8-bit-limb
+    # remainder keeps every partial product under 2**31
+    slope = span // denom
+    s2 = span - slope * denom
+    neg = ip < 0
+    aa = jnp.where(neg, -ip, ip)
+    hi = (aa >> 8) * s2
+    lo = (aa & 255) * s2
+    q1 = hi // denom
+    num = (hi - q1 * denom) * 256 + lo
+    q2 = num // denom
+    mag = q1 * 256 + q2
+    return jnp.where(neg, -mag, mag) + ip * slope
+
+
+def interp_promoted(ip, span, denom):
+    # the other sanctioned fix: explicit int64 promotion
+    wide = (ip.astype(jnp.int64) * span.astype(jnp.int64)) // denom
+    return wide.astype(jnp.int32)
+
+
+def static_shapes(n, votes):
+    # literal/constant factors and shift amounts are static python
+    # ints under trace — no wrap hazard
+    npad = -(-n // 128) * 128
+    key = votes << LIMB_BITS
+    return npad, key >> 4

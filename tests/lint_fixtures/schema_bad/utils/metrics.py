@@ -1,0 +1,15 @@
+"""Schema-drift bad twin, snapshot side: emits a key no export tuple
+documents ('orphan_key')."""
+
+
+class Metrics:
+    holes_in = 0
+
+    def snapshot(self):
+        snap = {
+            "holes_in": self.holes_in,
+            "orphan_key": 1,
+        }
+        if self.holes_in:
+            snap["elapsed_s"] = 0.0
+        return snap

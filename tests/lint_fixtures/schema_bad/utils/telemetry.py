@@ -1,0 +1,6 @@
+"""Schema-drift bad twin, consumer side: PROM_COUNTERS names a key
+snapshot() never emits ('missing_key')."""
+
+PROM_COUNTERS = ("holes_in", "missing_key")
+PROM_GAUGES = ("elapsed_s",)
+PROM_STRUCTURED = ("progress",)

@@ -1,0 +1,5 @@
+"""Schema-drift fixed sibling, consumer side."""
+
+PROM_COUNTERS = ("holes_in",)
+PROM_GAUGES = ("elapsed_s",)
+PROM_STRUCTURED = ()

@@ -1,0 +1,10 @@
+"""Known-bad twin for span-force: a device span that only times the
+async dispatch."""
+
+from ccsx_tpu.utils import trace
+
+
+def dispatch(step, big, small, group):
+    with trace.device_span("dispatch", group=group) as sp:
+        out = step(big, small)   # enqueue returns immediately
+    return out

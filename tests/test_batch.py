@@ -26,6 +26,9 @@ def test_z_bucket():
     assert _z_bucket(65) == 128  # keeps doubling: bounded retraces
 
 
+@pytest.mark.slow  # ~23s: fine-grained executor-vs-per-hole A/B; the
+# CLI batched==per-hole byte-identity pin below keeps the invariant
+# tier-1 (r20 budget audit)
 def test_executor_matches_per_hole_rounds(rng):
     """One batched dispatch == N independent per-hole rounds, bitwise."""
     cfg = CcsConfig(is_bam=False)
@@ -59,8 +62,8 @@ def test_executor_matches_per_hole_rounds(rng):
             rb.advance, win_mod._advance(ra, bp_eff).astype(np.int32))
 
 
-@pytest.mark.slow  # ~17s window sweep; per-hole-rounds and the CLI
-# batched==per-hole pin keep the executor tier-1 (r13 audit)
+@pytest.mark.slow  # ~17s window sweep; the CLI batched==per-hole pin
+# keeps the executor tier-1 (r13 audit; r20 moved per-hole-rounds slow)
 def test_executor_drives_windowed_gen_to_same_result(rng):
     """Driving the windowed generator with batched results reproduces the
     per-hole windowed consensus exactly."""
@@ -154,6 +157,9 @@ def test_cli_batched_whole_read_equals_per_hole(tmp_path, rng):
     assert o_ref.read_text() == o_bat.read_text()
 
 
+@pytest.mark.slow  # ~10s: a third batch-grid point (r20 budget audit,
+# same family as the two r16 demotions); the CLI batched==per-hole
+# byte-identity pin keeps ordering tier-1 at the default window
 def test_cli_batched_small_inflight_preserves_order(tmp_path, rng):
     """A tiny in-flight window forces staggered admission; output order
     must stay input order."""
@@ -274,6 +280,10 @@ def test_cli_mesh_too_large_clean_error(tmp_path, rng, capsys):
     assert out.read_text() == "precious\n"
 
 
+@pytest.mark.slow  # ~12s: transfer-protocol A/B; the single-device ==
+# multi-device dispatch pin (test_dispatch.py::test_fused_multichip_
+# byte_identical_to_single_device) keeps the divergence seam tier-1
+# (r20 budget audit)
 def test_packed_transfer_protocol_matches_unpacked(rng):
     """The packed single-device transfer protocol (one uint8 + one int32
     buffer each way, pipeline/batch._pack_args/_unpack_round/_unpack_

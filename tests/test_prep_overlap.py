@@ -157,6 +157,10 @@ def test_resolve_prep_threads():
 
 # ---------- fault tolerance through the pool -------------------------------
 
+@pytest.mark.slow  # ~15s: pool-thread fault A/B; the pool blast-radius
+# twin below (test_pair_gate_host_replay_failure_quarantines) and the
+# inline-path quarantine pins in test_faults.py stay tier-1 (r20
+# budget audit)
 def test_prep_fault_quarantines_one_hole(corpus, tmp_path):
     """An injected prep-point failure on a pool thread quarantines
     exactly that hole; the remaining output is the reference minus one

@@ -268,6 +268,11 @@ def test_cancel_mid_job_leaves_sibling_untouched(corpus, served):
 
 # ---------- drain + restart resume ----------
 
+@pytest.mark.slow  # ~31s: two full serve lifecycles; the CLI
+# drain->resume pin (test_salvage.py::test_sigterm_drain_then_resume_
+# byte_identical) and the fleet requeue-from-journal pin
+# (test_serve_fleet.py::test_dead_replica_job_requeues_to_survivor)
+# keep drain/resume tier-1 (r20 budget audit)
 def test_drain_rc75_and_restart_resumes_byte_identical(corpus, tmp_path):
     _, _, fa8, ref8 = corpus
     spool = str(tmp_path / "spool")

@@ -85,6 +85,12 @@ def corpus4(tmp_path_factory):
     return fa, ref
 
 
+@pytest.mark.slow  # ~29s: static-shepherd restart + shard-journal
+# resume e2e (r20 budget audit); the restart loop stays tier-1 via
+# test_shepherd_exhausted_restarts_fails_cleanly, the supervisor
+# reap-then-byte-identical pin via test_fleet.py::
+# test_fleet_run_sigkilled_worker_rebalances, and the slow chaos soak
+# keeps this exact shepherd_rank_death arm
 def test_shepherd_restarts_sigkilled_rank_and_merges(corpus4, tmp_path,
                                                      capsys):
     fa, ref = corpus4
@@ -111,8 +117,9 @@ def test_shepherd_restarts_sigkilled_rank_and_merges(corpus4, tmp_path,
     assert "rank_death" in log1
 
 
-@pytest.mark.slow  # ~25s: full-shepherd budget-accounting A/B; the
-# sigkilled-restart-and-merge e2e stays tier-1 (r16 budget audit)
+@pytest.mark.slow  # ~25s: full-shepherd budget-accounting A/B (r16
+# budget audit; r20 moved the sigkilled-restart e2e slow too — the
+# tier-1 keepers are named on its mark)
 def test_shepherd_drained_rank_is_not_charged_a_restart(corpus4,
                                                         tmp_path,
                                                         capsys):

@@ -73,6 +73,10 @@ def _get(port, path, timeout=1.0):
 # ---- endpoint server over a live run ---------------------------------------
 
 
+@pytest.mark.slow  # ~11s: full-CLI on/off A/B scrape (r20 budget
+# audit); the endpoint unit tests here and the live-HTTP pins in
+# test_serve.py (liveness/readiness against a running core) keep the
+# serving surface tier-1
 def test_endpoint_scrape_during_real_run(tmp_path, rng):
     """The acceptance path: /progress + /metrics + /healthz answer
     during a real batched CPU run, counters are monotone across
